@@ -1,0 +1,36 @@
+"""pycatkin_tpu: a TPU-native microkinetics framework.
+
+A ground-up JAX/XLA re-design with the full capability set of PyCatKin
+(DFT-landscape thermochemistry, TST kinetics, mean-field microkinetic
+models, idealised reactors, energy-span model, degree-of-rate-control,
+descriptor scans, uncertainty quantification) built as pure jitted
+functions over an immutable compiled ModelSpec, so condition sweeps and
+descriptor grids run as single batched device programs.
+
+Float64 is enabled by default: rate constants span ~30 decades and
+barriers sit in exponentials, so double precision is part of the numerical
+contract (disable with PYCATKIN_TPU_X64=0 at your own risk).
+"""
+
+import os as _os
+
+import jax as _jax
+
+if _os.environ.get("PYCATKIN_TPU_X64", "1") != "0":
+    _jax.config.update("jax_enable_x64", True)
+
+from . import constants
+from .engine import (FreeEnergies, ReactionEnergies, activity_from_tof, drc,
+                     drc_fd, free_energies, get_dydt, get_jacobian,
+                     make_rhs, make_steady_x, rate_constants,
+                     reaction_energies, reaction_rates_at, steady_state,
+                     tof, transient)
+from .frontend.loader import read_from_input_file
+from .frontend.reactions import (Reaction, ReactionDerivedReaction,
+                                 UserDefinedReaction)
+from .frontend.spec import Conditions, ModelSpec, build_spec
+from .frontend.states import ScalingState, State
+from .solvers.newton import SolverOptions, SteadyStateResults
+from .solvers.ode import ODEOptions, log_time_grid
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .energy_span import Energy, energy_span_model

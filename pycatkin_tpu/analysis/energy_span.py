@@ -1,0 +1,155 @@
+"""Energy landscapes and the Kozuch-Shaik energy span model.
+
+Capability parity with the reference ``Energy`` class
+(/root/reference/pycatkin/classes/energy.py:10-318): relative free /
+electronic landscapes over ordered minima (each a *list* of states summed)
+and the energy-span TOF estimate with TDTS/TDI identification and degrees
+of TOF control. The numerical core (:func:`energy_span_model`) is a pure
+jittable function of the landscape vector, so temperature sweeps vmap.
+
+Drawing utilities live in :mod:`pycatkin_tpu.api.plotting`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import R, eVtokJ, h, kB
+
+eVtoJmol = eVtokJ * 1.0e3
+
+
+class EnergySpanResult(NamedTuple):
+    tof: jnp.ndarray          # turnover frequency [1/s]
+    espan: jnp.ndarray        # energy span [eV]
+    i_tdts: jnp.ndarray       # landscape index of the TOF-determining TS
+    i_tdi: jnp.ndarray        # landscape index of the TOF-determining interm.
+    x_ts: jnp.ndarray         # [n_min] degree of TOF control per TS entry
+    x_int: jnp.ndarray        # [n_min] degree of TOF control per intermediate
+    eapp: jnp.ndarray         # apparent activation energy [kJ/mol]
+    drxn: jnp.ndarray         # overall reaction free energy [J/mol]
+
+
+def energy_span_model(vals: jnp.ndarray, is_ts: jnp.ndarray,
+                      T) -> EnergySpanResult:
+    """Energy span model over a relative landscape (reference
+    energy.py:238-310).
+
+    vals: [n_min] energies in eV relative to the first minimum; is_ts:
+    [n_min] 1 for transition-state entries. The XTOF matrix couples every
+    TS i with every intermediate j in (first, last) exclusive; when i >= j
+    the overall reaction energy is subtracted (the cycle wraps).
+    """
+    n = vals.shape[0]
+    vj = vals * eVtoJmol
+    drxn = vj[-1]
+    idx = jnp.arange(n)
+    row_ok = (is_ts > 0) & (idx <= n - 2)
+    col_ok = (is_ts == 0) & (idx >= 1) & (idx <= n - 2)
+    mask = row_ok[:, None] & col_ok[None, :]
+    wrap = (idx[:, None] >= idx[None, :]).astype(vj.dtype)
+    X = vj[:, None] - vj[None, :] - wrap * drxn
+    expX = jnp.where(mask, jnp.exp(X / (R * T)), 0.0)
+    den = jnp.sum(expX)
+    x_ts = jnp.sum(expX, axis=1) / den     # [n], nonzero on TS rows
+    x_int = jnp.sum(expX, axis=0) / den    # [n], nonzero on intermediate cols
+    i_tdts = jnp.argmax(x_ts)
+    i_tdi = jnp.argmax(x_int)
+    tof = (kB * T / h) * jnp.exp((-drxn / (R * T)) - 1.0) / den
+    espan = vals[i_tdts] - vals[i_tdi]
+    eapp = jnp.log(h * tof / (kB * T)) * (-R * T) * 1.0e-3
+    return EnergySpanResult(tof=tof, espan=espan, i_tdts=i_tdts,
+                            i_tdi=i_tdi, x_ts=x_ts, x_int=x_int,
+                            eapp=eapp, drxn=drxn)
+
+
+class Energy:
+    """An ordered energy landscape built from lists of states.
+
+    ``minima`` is a list of lists of State objects whose energies are
+    summed per entry (reference energy.py:12-60); an entry containing any
+    TS-typed state is a transition-state entry.
+    """
+
+    def __init__(self, name="landscape", minima=None, labels=None):
+        self.name = name
+        self.minima = minima
+        if labels is not None:
+            self.labels = labels
+        else:
+            self.labels = [entry[0].name for entry in minima]
+        assert len(self.labels) == len(self.minima)
+        self.energy_landscape = None
+        self._system = None  # set by System.add_energy_landscape
+
+    # ------------------------------------------------------------------
+    def entry_matrix(self, snames: Sequence[str]) -> np.ndarray:
+        """[n_min, n_s] counts of each species in each landscape entry."""
+        sindex = {n: i for i, n in enumerate(snames)}
+        M = np.zeros((len(self.minima), len(snames)))
+        for i, entry in enumerate(self.minima):
+            for st in entry:
+                M[i, sindex[st.name]] += 1.0
+        return M
+
+    @property
+    def is_ts(self) -> np.ndarray:
+        return np.array([1.0 if any(s.state_type == "TS" for s in entry)
+                         else 0.0 for entry in self.minima])
+
+    def construct_energy_landscape(self, T, p, verbose=False):
+        """Relative free/electronic landscape at (T, p) (reference
+        energy.py:39-60). Requires an owning System (for the engine)."""
+        sys = self._system
+        assert sys is not None, "Energy landscape is not attached to a System"
+        fe = sys.free_energy_table(T=T, p=p)
+        M = self.entry_matrix(sys.snames)
+        free = M @ np.asarray(fe.gfree)
+        elec = M @ np.asarray(fe.gelec)
+        is_ts = self.is_ts
+        self.energy_landscape = {
+            "free": {i: float(v - free[0]) for i, v in enumerate(free)},
+            "electronic": {i: float(v - elec[0]) for i, v in enumerate(elec)},
+            "isTS": {i: int(t) for i, t in enumerate(is_ts)},
+            "T": T, "p": p,
+        }
+        return self.energy_landscape
+
+    def _landscape_vector(self, T, p, etype="free", verbose=False):
+        if (self.energy_landscape is None or
+                self.energy_landscape["T"] != T or
+                self.energy_landscape["p"] != p):
+            self.construct_energy_landscape(T=T, p=p, verbose=verbose)
+        n = len(self.minima)
+        return np.array([self.energy_landscape[etype][i] for i in range(n)])
+
+    def evaluate_energy_span_model(self, T, p, etype="free", verbose=False,
+                                   opath=None):
+        """Reference-compatible evaluation (energy.py:238-318): returns
+        (tof, Espan, TDTS, TDI, num_i, num_j, lTi, lIj)."""
+        vals = self._landscape_vector(T, p, etype, verbose)
+        is_ts = self.is_ts
+        res = energy_span_model(jnp.asarray(vals), jnp.asarray(is_ts),
+                                float(T))
+        ts_rows = np.flatnonzero(is_ts > 0)
+        int_cols = [i for i in range(1, len(vals) - 1) if is_ts[i] == 0]
+        num_i = [float(res.x_ts[i]) for i in ts_rows]
+        num_j = [float(res.x_int[j]) for j in int_cols]
+        tdts = self.labels[int(res.i_tdts)]
+        tdi = self.labels[int(res.i_tdi)]
+        l_ti = [self.labels[i] for i in ts_rows]
+        l_ij = [self.labels[i] for i in range(len(vals))
+                if is_ts[i] == 0][1:-1]
+        if verbose:
+            print(f"Energy span ({T:.0f} K): TOF={float(res.tof):.3g} 1/s, "
+                  f"Espan={float(res.espan):.3g} eV, TDTS={tdts}, TDI={tdi}")
+        if opath is not None:
+            with open(opath, "w") as fh:
+                fh.write(str(float(res.tof)) + "\n")
+                fh.write(", ".join([str(v) for v in num_i] + ["\n"]))
+                fh.write(", ".join([str(v) for v in num_j] + ["\n"]))
+        return (float(res.tof), float(res.espan), tdts, tdi,
+                num_i, num_j, l_ti, l_ij)

@@ -1,0 +1,322 @@
+"""System: the single user-facing facade over the compiled engine.
+
+The reference ships two incompatible System APIs mid-refactor (legacy
+old_system.py:13-647 with a params dict / solve_odes / DRC / activity, and
+the patched system.py:33-639 with build()/get_dydt/find_steady). This
+class exposes ONE coherent union of both capability sets (SURVEY.md §1.2),
+implemented over the functional engine: host-side mutation of states,
+reactions or params is re-compiled into a fresh :class:`Conditions` pytree
+on each call, so the mutate-and-solve workflows of the reference examples
+keep working while the math runs as jitted device code.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+
+from .. import engine
+from ..analysis.energy_span import Energy
+from ..frontend.reactions import Reaction
+from ..frontend.spec import Conditions, build_spec, default_conditions
+from ..frontend.states import GAS, State
+from ..models.reactor import Reactor
+from ..solvers.newton import SolverOptions, SteadyStateResults
+from ..solvers.ode import ODEOptions, log_time_grid
+
+
+class System:
+
+    def __init__(self, times=None, start_state=None, inflow_state=None,
+                 T=293.15, p=101325.0, use_jacobian=True,
+                 ode_solver="trbdf2", nsteps=1.0e4, rtol=1.0e-8,
+                 atol=1.0e-10, xtol=1.0e-8, ftol=1.0e-8, verbose=False,
+                 min_tol=1.0e-32, n_out=300):
+        # Legacy-compatible parameter dict (reference old_system.py:154-174);
+        # sweep drivers mutate these keys directly.
+        self.params = {
+            "times": copy.deepcopy(times),
+            "start_state": copy.deepcopy(start_state) or {},
+            "inflow_state": copy.deepcopy(inflow_state) or {},
+            "temperature": T,
+            "pressure": p,
+            "rtol": rtol,
+            "atol": atol,
+            "xtol": xtol,
+            "ftol": ftol,
+            "jacobian": use_jacobian,
+            "nsteps": int(nsteps),
+            "ode_solver": ode_solver,
+            "verbose": verbose,
+            "n_out": int(n_out),
+        }
+        self.min_tol = min_tol
+        self.states: dict[str, State] = {}
+        self.reactions: dict[str, Reaction] = {}
+        self.reactor: Optional[Reactor] = None
+        self.energy_landscapes: dict[str, Energy] = {}
+
+        self._spec = None
+        self.times = None
+        self.solution = None
+        self.full_steady = None
+        self.steady_result: Optional[SteadyStateResults] = None
+
+    # -- new-API style scalar accessors --------------------------------
+    @property
+    def T(self):
+        return self.params["temperature"]
+
+    @T.setter
+    def T(self, value):
+        self.params["temperature"] = value
+
+    @property
+    def p(self):
+        return self.params["pressure"]
+
+    @p.setter
+    def p(self, value):
+        self.params["pressure"] = value
+
+    @property
+    def verbose(self):
+        return self.params["verbose"]
+
+    # ------------------------------------------------------------------
+    # construction
+    def add_state(self, state: State):
+        assert isinstance(state, State), "state must be a pycatkin_tpu State"
+        if state.name in self.states:
+            raise ValueError(
+                f"Found two copies of state {state.name}. "
+                "State names must be unique!")
+        if self.params["verbose"]:
+            print(f"Adding state {state.name}")
+        self.states[state.name] = state
+        self._spec = None
+
+    def add_reaction(self, reaction: Reaction):
+        assert isinstance(reaction, Reaction), \
+            "reaction must be a pycatkin_tpu Reaction"
+        if self.params["verbose"]:
+            print(f"Adding reaction {reaction.name}")
+        self.reactions[reaction.name] = reaction
+        self._spec = None
+
+    def add_reactor(self, reactor: Reactor):
+        assert isinstance(reactor, Reactor), \
+            "reactor must be a pycatkin_tpu Reactor"
+        self.reactor = reactor
+        self._spec = None
+
+    def add_energy_landscape(self, energy_landscape: Energy):
+        assert isinstance(energy_landscape, Energy)
+        energy_landscape._system = self
+        self.energy_landscapes[energy_landscape.name] = energy_landscape
+
+    # ------------------------------------------------------------------
+    # compilation
+    def build(self, force: bool = False):
+        """Compile the mechanism into the immutable ModelSpec (reference
+        system.py:167-186). Idempotent; re-run after structural changes."""
+        if self._spec is None or force:
+            rtype = self.reactor.reactor_type if self.reactor else None
+            rparams = self.reactor.params() if self.reactor else None
+            self._spec = build_spec(self.states, self.reactions,
+                                    reactor=rtype, reactor_params=rparams)
+        return self
+
+    @property
+    def spec(self):
+        self.build()
+        return self._spec
+
+    @property
+    def snames(self):
+        return list(self.spec.snames)
+
+    @property
+    def adsorbate_indices(self):
+        return list(self.spec.adsorbate_indices)
+
+    @property
+    def gas_indices(self):
+        return list(self.spec.gas_indices)
+
+    @property
+    def dynamic_indices(self):
+        return list(self.spec.dynamic_indices)
+
+    @property
+    def initial_system(self):
+        return np.asarray(self.conditions().y0)
+
+    def conditions(self, T=None, p=None, kscale=None,
+                   eps_extra: dict | None = None) -> Conditions:
+        """Snapshot current host-side model state into a Conditions pytree.
+
+        Reads (possibly user-mutated) State.Gelec values, user reaction
+        energies and energy modifiers -- the bridge from the reference's
+        mutate-and-solve style to the functional engine.
+        """
+        spec = self.spec
+        T = self.params["temperature"] if T is None else T
+        p = self.params["pressure"] if p is None else p
+        gelec_overrides = {name: st.Gelec for name, st in self.states.items()
+                           if st.Gelec is not None}
+        eps = {name: st.add_to_energy for name, st in self.states.items()
+               if st.add_to_energy}
+        if eps_extra:
+            for name, val in eps_extra.items():
+                eps[name] = eps.get(name, 0.0) + val
+        return default_conditions(
+            spec, self.reactions, T=T, p=p,
+            start_state=self.params.get("start_state"),
+            inflow_state=self.params.get("inflow_state"),
+            gelec_overrides=gelec_overrides, eps=eps, kscale=kscale)
+
+    # ------------------------------------------------------------------
+    # point evaluations
+    def free_energy_table(self, T=None, p=None) -> engine.FreeEnergies:
+        """All species' electronic/free energies and contributions at
+        (T, p); also writes them back onto the State objects, so
+        reference-style attribute access (state.Gfree etc.) works."""
+        fe = engine.free_energies(self.spec, self.conditions(T=T, p=p))
+        for i, name in enumerate(self.spec.snames):
+            st = self.states[name]
+            st.Gelec_computed = float(fe.gelec[i])
+            if not st.is_scaling and st.Gelec is None:
+                st.Gelec = float(fe.gelec[i])
+            st.Gvibr_computed = float(fe.gvibr[i])
+            st.Gtran_computed = float(fe.gtran[i])
+            st.Grota_computed = float(fe.grota[i])
+            st.Gfree_computed = float(fe.gfree[i])
+        return fe
+
+    def reaction_energy_table(self, T=None, p=None) -> engine.ReactionEnergies:
+        return engine.reaction_energies(self.spec, self.conditions(T=T, p=p))
+
+    def rate_constant_table(self, T=None, p=None):
+        kf, kr, keq = engine.rate_constants(self.spec,
+                                            self.conditions(T=T, p=p))
+        return np.asarray(kf), np.asarray(kr), np.asarray(keq)
+
+    def get_dydt(self, y, cond: Conditions | None = None):
+        return np.asarray(engine.get_dydt(self.spec,
+                                          cond or self.conditions(), y))
+
+    # legacy alias (old_system.py:227)
+    species_odes = get_dydt
+
+    def get_jacobian(self, y, cond: Conditions | None = None):
+        return np.asarray(engine.get_jacobian(self.spec,
+                                              cond or self.conditions(), y))
+
+    species_jacobian = get_jacobian
+
+    def reaction_terms(self, y, cond: Conditions | None = None):
+        """(n_r, 2) forward/reverse rates at y (reference
+        old_system.py:202-225). Also stored on self.rates."""
+        fwd, rev = engine.reaction_rates_at(self.spec,
+                                            cond or self.conditions(), y)
+        self.rates = np.stack([np.asarray(fwd), np.asarray(rev)], axis=1)
+        return self.rates
+
+    # ------------------------------------------------------------------
+    # solvers
+    def _ode_options(self) -> ODEOptions:
+        return ODEOptions(rtol=self.params["rtol"], atol=self.params["atol"])
+
+    def solver_options(self, **overrides) -> SolverOptions:
+        base = SolverOptions(floor=self.min_tol)
+        return base._replace(**overrides) if overrides else base
+
+    def solve_odes(self, n_out=None, times=None):
+        """Transient integration over the configured time span on a
+        log-spaced output grid (reference old_system.py:315-383). Stores
+        self.times / self.solution."""
+        times = times or self.params["times"]
+        assert times is not None, "System times are not set"
+        n_out = n_out or self.params.get("n_out", 300)
+        grid = np.asarray(log_time_grid(times[0], times[-1], n_out))
+        cond = self.conditions()
+        ys, ok = engine.transient(self.spec, cond, grid, self._ode_options())
+        self.times = grid
+        self.solution = np.asarray(ys)
+        if not bool(ok):
+            print("Warning: transient integration did not complete cleanly")
+        if self.params["verbose"]:
+            print("Final state:", dict(zip(self.spec.snames,
+                                           self.solution[-1])))
+        return self.solution
+
+    def find_steady(self, store_steady=False, y0=None,
+                    use_transient_guess=True, key=None,
+                    opts: SolverOptions | None = None) -> SteadyStateResults:
+        """Steady-state solve (union of reference old_system.py:385-468 and
+        system.py:566-639). Initial guess priority: explicit y0, then the
+        transient tail if available (legacy behavior), then the start
+        state."""
+        cond = self.conditions()
+        x0 = None
+        if y0 is not None:
+            x0 = np.asarray(y0)[self.spec.dynamic_indices]
+        elif use_transient_guess and self.solution is not None:
+            x0 = self.solution[-1][self.spec.dynamic_indices]
+        res = engine.steady_state(self.spec, cond, x0=x0, key=key,
+                                  opts=opts or self.solver_options())
+        self.steady_result = res
+        if store_steady or True:
+            self.full_steady = np.asarray(res.x)
+        if self.params["verbose"]:
+            print(f"Steady state: success={bool(res.success)} "
+                  f"residual={float(res.residual):.3g} "
+                  f"iters={int(res.iterations)}")
+        return res
+
+    # ------------------------------------------------------------------
+    # derived analyses (reference old_system.py:470-529)
+    def run_and_return_tof(self, tof_terms, ss_solve=False):
+        if ss_solve:
+            self.find_steady()
+            y = self.full_steady
+        else:
+            self.solve_odes()
+            y = self.solution[-1]
+        cond = self.conditions()
+        mask = engine.tof_mask_for(self.spec, tof_terms)
+        self.reaction_terms(y, cond)
+        return float(engine.tof(self.spec, cond, y, mask))
+
+    def degree_of_rate_control(self, tof_terms, ss_solve=True, eps=1.0e-3,
+                               mode="implicit"):
+        """DRC per reaction. mode='implicit': one reverse-mode pass through
+        the steady solve (TPU-native default); mode='fd': reference-parity
+        batched central differences (old_system.py:490-515)."""
+        cond = self.conditions()
+        x0 = (self.solution[-1][self.spec.dynamic_indices]
+              if self.solution is not None else None)
+        if x0 is None:
+            self.solve_odes()
+            x0 = self.solution[-1][self.spec.dynamic_indices]
+        if mode == "implicit":
+            xi = engine.drc(self.spec, cond, tof_terms, x0=x0,
+                            opts=self.solver_options())
+        else:
+            xi = engine.drc_fd(self.spec, cond, tof_terms, eps=eps, x0=x0,
+                               opts=self.solver_options())
+        return dict(zip(self.spec.rnames, np.asarray(xi)))
+
+    def activity(self, tof_terms, ss_solve=False):
+        tof_val = self.run_and_return_tof(tof_terms, ss_solve=ss_solve)
+        return float(engine.activity_from_tof(tof_val,
+                                              self.params["temperature"]))
+
+    # ------------------------------------------------------------------
+    def copy(self) -> "System":
+        new = copy.deepcopy(self)
+        new._spec = None
+        return new

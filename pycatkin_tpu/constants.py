@@ -1,0 +1,34 @@
+"""Physical constants and unit conversions.
+
+Values mirror the reference constant set actually in use
+(/root/reference/pycatkin/constants/physical_constants.py:14-27, the
+"Butadiene paper" set), because every golden regression number depends on
+these exact values.
+
+Internal unit conventions (identical to the reference):
+- energies per species: eV
+- reaction energies / barriers at the rate-constant boundary: J/mol
+- gas-phase solution entries: bar (multiply by ``bartoPa`` to get Pa)
+- rate constants: 1/s (Arrhenius, desorption) or 1/(s Pa) (adsorption)
+"""
+
+NA = 6.02214076e23
+bartoPa = 1.0e5
+atmtoPa = 1.01325e5
+
+kB = 1.380662e-23          # [J/K]
+h = 6.626176e-34           # [J s]
+JtoeV = 6.242e18
+eVtokJ = 96.485
+eVtokcal = 23.06
+kcaltoJ = 4184
+amutokg = 1.66053886e-27
+amuA2tokgm2 = 1.66053907e-47
+R = 8.31446262             # [J/(K mol)]
+
+# Derived, used by the thermo kernels.
+eVtoJmol = eVtokJ * 1.0e3  # eV -> J/mol
+
+# 12.4 meV frequency floor used when parsing DFT vibration output
+# (reference state.py:184-203). Expressed in Hz.
+FREQ_FLOOR_HZ = 12.4e-3 / (h * JtoeV)

@@ -1,0 +1,332 @@
+"""The device engine: pure jitted functions over (ModelSpec, Conditions).
+
+Composition of the kernel layers into the quantities the reference computes
+through its object graph:
+
+    free_energies      <- ops.thermo + compiled scaling relations
+    reaction_energies  <- stoichiometric sums (reference reaction.py:43-91)
+    rate_constants     <- ops.rates dispatch (reference reaction.py:94-168)
+    steady_state       <- solvers.newton PTC (reference find_steady paths)
+    transient          <- solvers.ode TR-BDF2 (reference solve_odes)
+    tof / activity     <- reference old_system.py:470-529
+    drc                <- autodiff through the steady solve via the implicit
+                          function theorem (replaces the reference's
+                          2*n_reactions finite-difference re-solves,
+                          old_system.py:490-515); FD mode kept for parity.
+
+Every function takes the spec as a static closure constant and a
+:class:`Conditions` pytree of runtime inputs, so sweeps over T, p,
+descriptor energies, noise or rate multipliers are ``jax.vmap`` axes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import JtoeV, R, bartoPa, eVtokJ, h, kB
+from .frontend.spec import REACTOR_CSTR, REACTOR_ID, Conditions, ModelSpec
+from .ops import network, rates, thermo
+from .solvers import newton
+from .solvers.newton import SolverOptions, SteadyStateResults
+from .solvers.ode import ODEOptions, integrate, log_time_grid
+
+eVtoJmol = eVtokJ * 1.0e3
+
+
+class FreeEnergies(NamedTuple):
+    gelec: jnp.ndarray   # [n_s] electronic (scaling relations resolved)
+    gfree: jnp.ndarray   # [n_s] total free energy
+    gvibr: jnp.ndarray
+    gtran: jnp.ndarray
+    grota: jnp.ndarray
+
+
+class ReactionEnergies(NamedTuple):
+    dErxn: jnp.ndarray   # [n_r] J/mol
+    dGrxn: jnp.ndarray
+    dEa_fwd: jnp.ndarray
+    dGa_fwd: jnp.ndarray
+    dEa_rev: jnp.ndarray
+    dGa_rev: jnp.ndarray
+
+
+def free_energies(spec: ModelSpec, cond: Conditions) -> FreeEnergies:
+    """Electronic + free energies of every species at (T, p) [eV]."""
+    gv, gt, gr = thermo.thermal_contributions(
+        cond.T, cond.p,
+        freq=spec.freq, fmask=spec.fmask, mass=spec.mass, sigma=spec.sigma,
+        inertia=spec.inertia, is_gas=spec.is_gas, is_linear=spec.is_linear,
+        mix=spec.mix,
+        gvibr0=spec.gvibr0, gvibr_mask=spec.gvibr_mask,
+        gtran0=spec.gtran0, gtran_mask=spec.gtran_mask,
+        grota0=spec.grota0, grota_mask=spec.grota_mask)
+
+    e_full = jnp.asarray(cond.gelec)
+    if spec.scl_idx.size:
+        # Linear scaling relations, solved as a (tiny) linear system to
+        # allow scaling states referencing each other
+        # (reference state.py:490-517 evaluated sequentially).
+        b = spec.scl_b + spec.scl_We @ e_full + spec.scl_WuE @ cond.uE_rxn
+        n_sc = spec.scl_idx.size
+        e_scl = jnp.linalg.solve(jnp.eye(n_sc) - spec.scl_Ws, b)
+        e_full = e_full.at[spec.scl_idx].set(e_scl)
+
+    mods = spec.add0 + cond.eps
+    g0 = e_full + gv + gt + gr + mods
+    if spec.udar_mask.any():
+        # use_descriptor_as_reactant free-energy assembly
+        # (reference state.py:519-565).
+        corr = (spec.udar_Ce @ e_full + spec.udar_Cg @ g0 +
+                spec.udar_CuE @ cond.uE_rxn + spec.udar_CuG @ cond.uG_rxn)
+        g = jnp.where(spec.udar_mask > 0, e_full + corr + mods, g0)
+    else:
+        g = g0
+    if spec.gfree_mask.any():
+        g = jnp.where(spec.gfree_mask > 0, spec.gfree0 + mods, g)
+    return FreeEnergies(gelec=e_full, gfree=g, gvibr=gv, gtran=gt, grota=gr)
+
+
+def reaction_energies(spec: ModelSpec, cond: Conditions,
+                      fe: FreeEnergies | None = None) -> ReactionEnergies:
+    """Reaction energies and barriers [J/mol] (reference reaction.py:43-91,
+    222-274, 312-339). User-defined reactions take their energies from the
+    condition vectors; TS-less reactions have zero barriers."""
+    if fe is None:
+        fe = free_energies(spec, cond)
+    e, g = fe.gelec, fe.gfree
+
+    dE = (spec.SP - spec.SR) @ e * eVtoJmol
+    dG = (spec.SP - spec.SR) @ g * eVtoJmol
+    dE = jnp.where(cond.u_rxn_mask > 0, cond.uE_rxn * eVtoJmol, dE)
+    dG = jnp.where(cond.u_rxn_mask > 0, cond.uG_rxn * eVtoJmol, dG)
+
+    dEa_ts = (spec.ST - spec.SR) @ e * eVtoJmol * spec.has_TS
+    dGa_ts = (spec.ST - spec.SR) @ g * eVtoJmol * spec.has_TS
+    # User-defined reactions never fall back to TS sums
+    # (reference reaction.py:222-274 ignores TS states entirely).
+    dEa = jnp.where(spec.is_user > 0,
+                    cond.uEa * eVtoJmol * cond.u_bar_mask, dEa_ts)
+    dGa = jnp.where(spec.is_user > 0,
+                    cond.uGa * eVtoJmol * cond.u_bar_mask, dGa_ts)
+    return ReactionEnergies(
+        dErxn=dE, dGrxn=dG, dEa_fwd=dEa, dGa_fwd=dGa,
+        dEa_rev=dEa - dE, dGa_rev=dGa - dG)
+
+
+def rate_constants(spec: ModelSpec, cond: Conditions,
+                   re: ReactionEnergies | None = None):
+    """(kf, kr, Keq) for every reaction (reference reaction.py:94-168)."""
+    if re is None:
+        re = reaction_energies(spec, cond)
+    act = cond.is_activated
+    return rates.rate_constants(
+        cond.T,
+        dGrxn=re.dGrxn, dErxn=re.dErxn, dGa_fwd=re.dGa_fwd,
+        is_arr=act,
+        is_ads=spec.is_ads * (1.0 - act),
+        is_des=spec.is_des * (1.0 - act),
+        is_ghost=spec.is_ghost,
+        reversible=spec.reversible,
+        area=spec.area, gas_mass=spec.gas_mass, gas_sigma=spec.gas_sigma,
+        gas_inertia=spec.gas_inertia, gas_polyatomic=spec.gas_polyatomic,
+        kscale=cond.kscale,
+        collision_des=(spec.desorption_model == "collision"))
+
+
+def _reactor_terms(spec: ModelSpec, cond: Conditions):
+    if spec.reactor_type == REACTOR_CSTR:
+        sigma = kB * cond.T * spec.catalyst_area / spec.volume
+        return dict(reactor_type=REACTOR_CSTR,
+                    sigma_over_bar=sigma / bartoPa,
+                    inv_tau=1.0 / spec.residence_time,
+                    inflow=jnp.asarray(cond.inflow))
+    return dict(reactor_type=REACTOR_ID, sigma_over_bar=0.0, inv_tau=0.0,
+                inflow=jnp.asarray(cond.inflow))
+
+
+def make_rhs(spec: ModelSpec, cond: Conditions, kf=None, kr=None):
+    """Build the reactor ODE right-hand side y -> dy/dt as a closure."""
+    if kf is None:
+        kf, kr, _ = rate_constants(spec, cond)
+    terms = _reactor_terms(spec, cond)
+    static = dict(reac_idx=spec.reac_idx, prod_idx=spec.prod_idx,
+                  is_gas=spec.is_gas, stoich=spec.stoich,
+                  is_adsorbate=spec.is_adsorbate, **terms)
+
+    def rhs(y):
+        return network.reactor_rhs(y, 0.0, kf, kr, **static)
+    return rhs
+
+
+def get_dydt(spec: ModelSpec, cond: Conditions, y):
+    """dy/dt of the full solution vector (reference system.py:396-416)."""
+    return make_rhs(spec, cond)(y)
+
+
+def get_jacobian(spec: ModelSpec, cond: Conditions, y):
+    """d(dy/dt)/dy (reference system.py:493-508) via forward autodiff."""
+    return jax.jacfwd(make_rhs(spec, cond))(y)
+
+
+def reaction_rates_at(spec: ModelSpec, cond: Conditions, y, kf=None, kr=None):
+    """Per-reaction forward/reverse rates at composition y
+    (reference old_system.py:202-225)."""
+    if kf is None:
+        kf, kr, _ = rate_constants(spec, cond)
+    return network.reaction_rates(jnp.asarray(y), kf, kr,
+                                  reac_idx=spec.reac_idx,
+                                  prod_idx=spec.prod_idx,
+                                  is_gas=spec.is_gas)
+
+
+# ----------------------------------------------------------------------
+# solvers
+def _dynamic_residual(spec: ModelSpec, cond: Conditions, kf, kr):
+    dyn = jnp.asarray(spec.dynamic_indices)
+    rhs = make_rhs(spec, cond, kf, kr)
+    y_base = jnp.asarray(cond.y0)
+
+    def residual(x):
+        y = y_base.at[dyn].set(x)
+        return rhs(y)[dyn]
+    return residual, dyn, y_base
+
+
+def steady_state(spec: ModelSpec, cond: Conditions,
+                 x0=None, key=None,
+                 opts: SolverOptions = SolverOptions()) -> SteadyStateResults:
+    """Steady-state solve over the dynamic indices (adsorbates, plus gas
+    for CSTR), gas clamped otherwise -- reference system.py:512-639 /
+    old_system.py:385-434 semantics with on-device retry logic."""
+    kf, kr, _ = rate_constants(spec, cond)
+    residual, dyn, y_base = _dynamic_residual(spec, cond, kf, kr)
+    jac = jax.jacfwd(residual)
+    if x0 is None:
+        x0 = y_base[dyn]
+    groups_dyn = jnp.asarray(spec.groups)[:, dyn]
+    x, success, res, iters, attempts = newton.solve_steady(
+        residual, jac, jnp.asarray(x0), groups_dyn, opts, key=key)
+    y_full = y_base.at[dyn].set(x)
+    return SteadyStateResults(x=y_full, success=success, residual=res,
+                              iterations=iters, attempts=attempts)
+
+
+def transient(spec: ModelSpec, cond: Conditions, save_ts,
+              opts: ODEOptions = ODEOptions()):
+    """Integrate the reactor ODEs over ``save_ts`` (reference
+    old_system.py:315-378). Returns (ys [t, n_s], ok)."""
+    rhs = make_rhs(spec, cond)
+    jac = jax.jacfwd(rhs)
+    return integrate(rhs, jac, jnp.asarray(cond.y0, dtype=jnp.float64),
+                     jnp.asarray(save_ts), opts)
+
+
+# ----------------------------------------------------------------------
+# derived quantities
+def tof(spec: ModelSpec, cond: Conditions, y, tof_mask):
+    """Turnover frequency: sum of net rates of the selected steps at y
+    (reference old_system.py:470-488)."""
+    fwd, rev = reaction_rates_at(spec, cond, y)
+    return jnp.sum(jnp.asarray(tof_mask) * (fwd - rev))
+
+
+def activity_from_tof(tof_value, T):
+    """Activity [eV] = ln(h*TOF/kB*T) * RT (reference
+    old_system.py:517-529)."""
+    return (jnp.log(h * tof_value / (kB * T)) * (R * T)) * 1.0e-3 / eVtokJ
+
+
+def tof_mask_for(spec: ModelSpec, tof_terms) -> np.ndarray:
+    mask = np.zeros(spec.n_reactions)
+    for t in tof_terms:
+        mask[spec.rindex(t)] = 1.0
+    return mask
+
+
+# ----------------------------------------------------------------------
+# implicit differentiation through the steady state
+def make_steady_x(spec: ModelSpec, opts: SolverOptions = SolverOptions(),
+                  x0=None, key=None):
+    """Return ``f(cond) -> x_dyn`` differentiable via the implicit function
+    theorem: at F(x*, cond) = 0, dx*/dcond = -J^-1 dF/dcond. The backward
+    pass costs ONE adjoint linear solve instead of the reference's
+    2*n_reactions full re-solves (old_system.py:490-515)."""
+
+    def _solve(cond):
+        res = steady_state(spec, cond, x0=x0, key=key, opts=opts)
+        return res.x[jnp.asarray(spec.dynamic_indices)]
+
+    def _residual(x, cond):
+        kf, kr, _ = rate_constants(spec, cond)
+        residual, _, _ = _dynamic_residual(spec, cond, kf, kr)
+        return residual(x)
+
+    @jax.custom_vjp
+    def xstar(cond):
+        return _solve(cond)
+
+    def fwd(cond):
+        x = _solve(cond)
+        return x, (x, cond)
+
+    def bwd(saved, xbar):
+        x, cond = saved
+        J = jax.jacfwd(_residual, argnums=0)(x, cond)
+        w = jnp.linalg.solve(J.T, xbar)
+        _, vjp_cond = jax.vjp(lambda c: _residual(x, c), cond)
+        (cond_bar,) = vjp_cond(-w)
+        return (cond_bar,)
+
+    xstar.defvjp(fwd, bwd)
+    return xstar
+
+
+def drc(spec: ModelSpec, cond: Conditions, tof_terms,
+        opts: SolverOptions = SolverOptions(), x0=None, key=None):
+    """Degrees of rate control xi_r = d ln TOF / d ln k_r with both kf and
+    kr scaled together (preserving Keq), exactly the reference perturbation
+    channel (old_system.py:214-217,490-515) but via one reverse-mode pass.
+
+    Returns [n_r] array ordered like spec.rnames.
+    """
+    mask = tof_mask_for(spec, tof_terms)
+    xstar = make_steady_x(spec, opts, x0=x0, key=key)
+    dyn = jnp.asarray(spec.dynamic_indices)
+    y_base = jnp.asarray(cond.y0)
+
+    def ln_tof(kscale):
+        c = cond._replace(kscale=kscale)
+        x = xstar(c)
+        y = y_base.at[dyn].set(x)
+        return jnp.log(tof(spec, c, y, mask))
+
+    return jax.grad(ln_tof)(jnp.asarray(cond.kscale))
+
+
+def drc_fd(spec: ModelSpec, cond: Conditions, tof_terms, eps: float = 1e-3,
+           opts: SolverOptions = SolverOptions(), x0=None, key=None):
+    """Finite-difference DRC for parity with the reference
+    (old_system.py:490-515): central difference with kf,kr scaled by
+    (1 +/- eps), all 2*n_r+1 solves batched through ``vmap``."""
+    mask = jnp.asarray(tof_mask_for(spec, tof_terms))
+    n_r = spec.n_reactions
+    base = jnp.asarray(cond.kscale)
+    scales = jnp.concatenate([
+        base[None, :],
+        base[None, :] * (1.0 + eps * jnp.eye(n_r)),
+        base[None, :] * (1.0 - eps * jnp.eye(n_r)),
+    ], axis=0)
+
+    def solve_tof(kscale):
+        c = cond._replace(kscale=kscale)
+        res = steady_state(spec, c, x0=x0, key=key, opts=opts)
+        return tof(spec, c, res.x, mask)
+
+    tofs = jax.vmap(solve_tof)(scales)
+    t0, tp, tm = tofs[0], tofs[1:1 + n_r], tofs[1 + n_r:]
+    return (tp - tm) / (2.0 * eps * t0)

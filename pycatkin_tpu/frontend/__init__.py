@@ -1,0 +1,4 @@
+from .loader import read_from_input_file
+from .reactions import Reaction, ReactionDerivedReaction, UserDefinedReaction
+from .spec import Conditions, ModelSpec, build_spec, default_conditions
+from .states import ScalingState, State

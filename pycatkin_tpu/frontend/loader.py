@@ -1,0 +1,153 @@
+"""JSON input loader: reference-compatible schema -> System facade.
+
+Reads the exact input schema of the reference
+(/root/reference/pycatkin/functions/load_input.py:9-168): top-level
+sections ``states``, ``scaling relation states``, ``system``,
+``reactions``, ``manual reactions``, ``reaction derived reactions``,
+``reactor`` and ``energy landscapes``, including the unit fixup that
+multiplies gas start/inflow entries by p/1e5 (bar) and the name->object
+resolution passes for reaction members, gasdata and scaling reactions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..analysis.energy_span import Energy
+from ..constants import bartoPa
+from ..models.reactor import CSTReactor, InfiniteDilutionReactor
+from .reactions import Reaction, ReactionDerivedReaction, UserDefinedReaction
+from .states import ADSORBATE, GAS, SURFACE, ScalingState, State
+
+
+def read_from_input_file(input_path="input.json", base_system=None,
+                         base_path=None, verbose=False):
+    """Build a System from a JSON input file.
+
+    base_system: donor System for 'reaction derived reactions' whose
+    base_reaction names resolve there (reference load_input.py:95-114).
+    base_path: directory against which relative state paths are resolved
+    (defaults to the input file's directory, which is what the reference
+    tests emulate by rewriting paths, test_1.py:22-31).
+    """
+    from ..api.system import System
+
+    if verbose:
+        print(f"Loading input file: {input_path}.")
+    with open(input_path) as fh:
+        cfg = json.load(fh)
+
+    if base_path is None:
+        base_path = os.path.dirname(os.path.abspath(input_path))
+
+    def _resolve_path(p):
+        if p is None or os.path.isabs(p):
+            return p
+        return os.path.join(base_path, p)
+
+    if "states" not in cfg:
+        raise RuntimeError("Input file contains no states.")
+
+    states: dict[str, State] = {}
+    for name, scfg in cfg["states"].items():
+        scfg = dict(scfg)
+        for key in ("path", "vibs_path"):
+            if key in scfg:
+                scfg[key] = _resolve_path(scfg[key])
+        states[name] = State(name=name, **scfg)
+
+    for name, scfg in cfg.get("scaling relation states", {}).items():
+        scfg = dict(scfg)
+        for key in ("path", "vibs_path"):
+            if key in scfg:
+                scfg[key] = _resolve_path(scfg[key])
+        states[name] = ScalingState(name=name, **scfg)
+
+    if "system" not in cfg:
+        raise RuntimeError("Input file contains no system details.")
+    sys_params = dict(cfg["system"])
+    p = sys_params["p"]
+    # Gas start/inflow entries arrive as fractions of total pressure and
+    # are stored in bar (reference load_input.py:47-60).
+    startsites = 0.0
+    for name, val in sys_params.get("start_state", {}).items():
+        if states[name].state_type == GAS:
+            sys_params["start_state"][name] = val * p / bartoPa
+        elif states[name].state_type in (SURFACE, ADSORBATE):
+            startsites += val
+    if "start_state" in sys_params and startsites == 0.0:
+        raise ValueError(
+            "Initial surface coverage cannot be zero for all states!")
+    for name, val in sys_params.get("inflow_state", {}).items():
+        if states[name].state_type != GAS:
+            raise TypeError("Only gas states can comprise the inflow!")
+        sys_params["inflow_state"][name] = val * p / bartoPa
+
+    sim = System(**sys_params)
+    for name, st in states.items():
+        if st.gasdata is not None:
+            st.gasdata["state"] = [states[s] for s in st.gasdata["state"]]
+        sim.add_state(st)
+
+    reactions: dict[str, Reaction] = {}
+
+    def _wire(rx_cfg):
+        rx_cfg = dict(rx_cfg)
+        rx_cfg["reactants"] = [states[s] for s in rx_cfg["reactants"]]
+        rx_cfg["products"] = [states[s] for s in rx_cfg["products"]]
+        if rx_cfg.get("TS") is not None:
+            rx_cfg["TS"] = [states[s] for s in rx_cfg["TS"]]
+        return rx_cfg
+
+    for name, rcfg in cfg.get("reactions", {}).items():
+        reactions[name] = Reaction(name=name, **_wire(rcfg))
+    for name, rcfg in cfg.get("manual reactions", {}).items():
+        reactions[name] = UserDefinedReaction(name=name, **_wire(rcfg))
+    if "reaction derived reactions" in cfg:
+        donor = base_system.reactions if base_system is not None else reactions
+        for name, rcfg in cfg["reaction derived reactions"].items():
+            rcfg = _wire(rcfg)
+            base_name = rcfg.pop("base_reaction")
+            reactions[name] = ReactionDerivedReaction(
+                name=name, base_reaction=donor[base_name], **rcfg)
+
+    # Resolve scaling-reaction name references now that reactions exist
+    # (reference load_input.py:116-128).
+    for st in states.values():
+        if isinstance(st, ScalingState):
+            for key, entry in st.scaling_reactions.items():
+                if isinstance(entry["reaction"], str):
+                    entry["reaction"] = reactions[entry["reaction"]]
+
+    for rx in reactions.values():
+        sim.add_reaction(rx)
+
+    if "reactor" in cfg:
+        rcfg = cfg["reactor"]
+        if not isinstance(rcfg, dict):
+            if rcfg == "InfiniteDilutionReactor":
+                sim.add_reactor(InfiniteDilutionReactor())
+            else:
+                raise TypeError(
+                    "Only InfiniteDilutionReactor can be specified without "
+                    "reactor parameters.")
+        elif "InfiniteDilutionReactor" in rcfg:
+            sim.add_reactor(InfiniteDilutionReactor())
+        elif "CSTReactor" in rcfg:
+            sim.add_reactor(CSTReactor(**rcfg["CSTReactor"]))
+        else:
+            raise TypeError("Unknown reactor option, please choose "
+                            "InfiniteDilutionReactor or CSTReactor.")
+    elif reactions:
+        raise RuntimeError(
+            "Cannot consider reactions without reactor. To use constant "
+            "boundary conditions, please specify InfiniteDilutionReactor.")
+
+    for pes, lcfg in cfg.get("energy landscapes", {}).items():
+        minima = [[states[s] for s in entry] for entry in lcfg["minima"]]
+        labels = lcfg.get("labels") or [e[0].name for e in minima]
+        sim.add_energy_landscape(Energy(name=pes, minima=minima,
+                                        labels=labels))
+
+    return sim

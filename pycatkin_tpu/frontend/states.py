@@ -1,0 +1,259 @@
+"""Host-side species description.
+
+A :class:`State` is a *data resolver*: it loads electronic energies,
+vibrational frequencies, masses and moments of inertia from DFT artifacts
+(or takes them from the input file) and exposes them as static arrays for
+the spec compiler. It performs **no** thermochemistry itself -- all free
+energy math lives in :mod:`pycatkin_tpu.ops.thermo` as jitted kernels, so
+there is exactly one implementation of the physics.
+
+Capability parity with the reference ``State``/``ScalingState``
+(/root/reference/pycatkin/classes/state.py:10-590): state types
+(gas/adsorbate/surface/TS), energy/frequency sources (datafile, inputfile,
+OUTCAR, log.vib), frequency floor + DOF padding rules, mode-truncation
+counts, gas shape detection, gas-mixture (``gasdata``) corrections, energy
+modifiers, and linear scaling relations (incl. ``dereference`` and
+``use_descriptor_as_reactant``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from . import parsers
+
+GAS = "gas"
+ADSORBATE = "adsorbate"
+SURFACE = "surface"
+TS = "TS"
+
+STATE_TYPES = (GAS, ADSORBATE, SURFACE, TS)
+
+# Moments of inertia below this (amu*A^2) are treated as numerically zero
+# when detecting linear molecules (reference state.py:69,99).
+INERTIA_CUTOFF = 1.0e-12
+
+
+@dataclass
+class State:
+    """One species: gas molecule, adsorbate, bare surface or transition state."""
+
+    name: str
+    state_type: str = None
+    path: Optional[str] = None
+    vibs_path: Optional[str] = None
+    sigma: Optional[float] = None
+    mass: Optional[float] = None
+    inertia: Optional[np.ndarray] = None
+    gasdata: Optional[dict] = None
+    add_to_energy: Optional[float] = None
+    truncate_freq: bool = True
+    energy_source: Optional[str] = None
+    freq_source: Optional[str] = None
+    freq: Optional[np.ndarray] = None
+    i_freq: Optional[np.ndarray] = None
+    Gelec: Optional[float] = None
+    Gzpe: Optional[float] = None
+    Gvibr: Optional[float] = None
+    Gtran: Optional[float] = None
+    Grota: Optional[float] = None
+    Gfree: Optional[float] = None
+    read_from_alternate: Optional[dict] = None
+
+    # Resolved lazily:
+    shape: Optional[int] = field(default=None, repr=False)
+    _loaded: bool = field(default=False, repr=False)
+
+    def __post_init__(self):
+        if self.state_type not in STATE_TYPES and self.state_type is not None:
+            raise ValueError(
+                f"state {self.name}: unknown state_type {self.state_type!r}")
+        # Fixed-value thermo contributions supplied directly in the input
+        # file short-circuit the corresponding kernel (reference
+        # state.py:52-55 "inputfile" sources).
+        self.tran_source = None if self.Gtran is None else "inputfile"
+        self.rota_source = None if self.Grota is None else "inputfile"
+        self.vibr_source = None if self.Gvibr is None else "inputfile"
+        self.free_source = None if self.Gfree is None else "inputfile"
+        if self.freq is not None:
+            self.freq_source = "inputfile"
+            self.freq = np.array(sorted(self.freq, reverse=True), dtype=float)
+            self.i_freq = (np.array(sorted(self.i_freq, reverse=True), dtype=float)
+                           if self.i_freq is not None else np.array([]))
+        if self.inertia is not None:
+            self._set_inertia(np.asarray(self.inertia, dtype=float))
+        if self.state_type == GAS and self.sigma is None:
+            raise ValueError(f"gas state {self.name} requires a symmetry number")
+
+    # ------------------------------------------------------------------
+    # data resolution
+    def _set_inertia(self, inertia: np.ndarray):
+        inertia = np.where(inertia > INERTIA_CUTOFF, inertia, 0.0)
+        self.inertia = inertia
+        self.shape = int((inertia > 0.0).sum())
+        if self.state_type == GAS and self.shape < 2:
+            print(f"state {self.name}: too many zero moments of inertia")
+
+    def load(self, verbose: bool = False):
+        """Resolve electronic energy, frequencies and geometry from sources."""
+        if self._loaded:
+            return self
+        self._load_structure(verbose)
+        self._load_frequencies(verbose)
+        self._load_energy(verbose)
+        self._loaded = True
+        return self
+
+    def _load_structure(self, verbose: bool):
+        needs_geometry = (self.state_type == GAS and
+                          (self.mass is None or self.inertia is None))
+        if not needs_geometry:
+            return
+        if self.read_from_alternate and "get_atoms" in self.read_from_alternate:
+            _, self.mass, inertia = self.read_from_alternate["get_atoms"]()
+            self._set_inertia(np.asarray(inertia, dtype=float))
+            return
+        if self.path is None:
+            if self.mass is None:
+                raise ValueError(
+                    f"gas state {self.name}: no mass and no path to read it")
+            # Mass given but no inertia source: rotational contributions
+            # are unavailable (engine returns 0 for them). Legitimate for
+            # species whose free energy never enters the model (e.g.
+            # user-defined reaction members, COOxVolcano CO/O2/CO2).
+            self._set_inertia(np.zeros(3))
+            return
+        data = parsers.read_outcar(parsers.resolve_outcar_path(self.path))
+        if self.mass is None:
+            self.mass = data["mass"]
+        if self.inertia is None:
+            self._set_inertia(data["inertia"])
+
+    def _load_frequencies(self, verbose: bool):
+        if self.freq is not None or self.vibr_source == "inputfile":
+            return
+        if self.freq_source == "datafile":
+            freq, i_freq = parsers.read_frequency_dat(self.vibs_path)
+            self.freq = np.array(sorted(freq, reverse=True))
+            self.i_freq = np.asarray(i_freq)
+            return
+        freq = i_freq = None
+        if self.read_from_alternate and "get_vibrations" in self.read_from_alternate:
+            freq, i_freq = self.read_from_alternate["get_vibrations"]()
+        if not freq:
+            base = self.vibs_path if self.vibs_path is not None else self.path
+            if base is None:
+                self.freq = np.zeros(0)
+                self.i_freq = np.zeros(0)
+                return
+            log_vib = os.path.join(base, "log.vib")
+            if os.path.isfile(log_vib):
+                freq, i_freq = parsers.read_log_vib(log_vib)
+            else:
+                freq, i_freq = parsers.read_outcar_frequencies(
+                    parsers.resolve_outcar_path(self.path))
+        if self.truncate_freq:
+            if self.state_type == GAS and self.shape is None:
+                self._load_structure(verbose)
+            freq = parsers.apply_frequency_floor(
+                list(freq), list(i_freq), self.state_type, verbose)
+        self.freq = np.array(sorted(freq, reverse=True))
+        self.i_freq = np.asarray(list(i_freq), dtype=float)
+
+    def _load_energy(self, verbose: bool):
+        if self.Gelec is not None:
+            return
+        if self.energy_source == "datafile":
+            self.Gelec = parsers.read_energy_dat(self.path)
+            return
+        if (self.read_from_alternate and
+                "get_electronic_energy" in self.read_from_alternate):
+            self.Gelec = self.read_from_alternate["get_electronic_energy"]()
+            return
+        if self.path is not None:
+            data = parsers.read_outcar(parsers.resolve_outcar_path(self.path))
+            self.Gelec = data["energy"]
+        # else: stays None -- scaling states and runtime-overridden
+        # descriptor states resolve their Gelec elsewhere.
+
+    # ------------------------------------------------------------------
+    # spec inputs
+    @property
+    def n_truncate(self) -> int:
+        """Number of highest-index (smallest) modes dropped from vibrational
+        sums: gas drops ``shape`` rotational placeholders, a TS without an
+        identified imaginary mode drops one (reference state.py:276-283)."""
+        if self.state_type == GAS:
+            return int(self.shape or 0)
+        if self.state_type == TS and (self.i_freq is None or len(self.i_freq) == 0):
+            return 1
+        return 0
+
+    def used_frequencies(self) -> np.ndarray:
+        """Frequencies (Hz, descending) that enter ZPE/vibrational sums."""
+        self.load()
+        if self.freq is None or self.freq.size == 0:
+            return np.zeros(0)
+        nfreqs = self.freq.shape[0] - self.n_truncate
+        return self.freq[:max(nfreqs, 0)]
+
+    def set_energy_modifier(self, modifier):
+        self.add_to_energy = modifier
+
+    @property
+    def is_scaling(self) -> bool:
+        return False
+
+
+@dataclass
+class ScalingState(State):
+    """Species whose electronic energy is a linear scaling relation over
+    descriptor reaction energies (reference state.py:466-565).
+
+    ``Gelec = intercept + sum_i multiplicity_i * gradient_i * dE_i`` with
+    ``dE_i`` the electronic energy of descriptor reaction i. With
+    ``dereference``, each term adds the descriptor reaction's summed
+    reactant electronic energies. With ``use_descriptor_as_reactant``, the
+    free energy is assembled from descriptor reaction free/electronic
+    energies instead of this state's own partition functions.
+    """
+
+    scaling_coeffs: Optional[dict] = None
+    scaling_reactions: Optional[dict] = None
+    dereference: bool = False
+    use_descriptor_as_reactant: bool = False
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.scaling_coeffs is None or self.scaling_reactions is None:
+            raise ValueError(
+                f"scaling state {self.name} needs scaling_coeffs and "
+                "scaling_reactions")
+
+    def _load_energy(self, verbose: bool):
+        # Electronic energy comes from the scaling relation at engine time.
+        pass
+
+    @property
+    def is_scaling(self) -> bool:
+        return True
+
+    def gradients(self) -> list[float]:
+        g = self.scaling_coeffs["gradient"]
+        n = len(self.scaling_reactions)
+        if np.isscalar(g):
+            return [float(g)] * n
+        g = list(g)
+        if len(g) == 1:
+            return [float(g[0])] * n
+        assert len(g) == n, (
+            f"scaling state {self.name}: {len(g)} gradients for {n} reactions")
+        return [float(x) for x in g]
+
+    def multiplicities(self) -> list[float]:
+        return [float(r.get("multiplicity", 1.0))
+                for r in self.scaling_reactions.values()]

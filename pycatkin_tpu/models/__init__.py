@@ -1,0 +1,1 @@
+from .reactor import CSTReactor, InfiniteDilutionReactor, Reactor

@@ -1,0 +1,119 @@
+"""Rate-constant kernels (JAX).
+
+TST/collision-theory rate constants for the whole network at once
+(reference rate_constants.py:6-96, reaction.py:94-168). Reaction-type
+dispatch is resolved at spec-build time into static masks; here everything
+is branch-free ``where`` algebra so it jits, vmaps and differentiates.
+
+Units: T [K], barriers/reaction energies [J/mol], masses [amu], areas
+[m^2], moments of inertia [amu*A^2]. Arrhenius/desorption constants in
+[1/s]; adsorption in [1/(s*Pa)].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import R, amuA2tokgm2, amutokg, h, kB
+
+
+def prefactor(T):
+    """TST prefactor kB*T/h [1/s] (reference rate_constants.py:89-96)."""
+    return kB * T / h
+
+
+def k_arrhenius(T, prefac, barrier):
+    """A*exp(-Ea/RT) (reference rate_constants.py:6-13)."""
+    return prefac * jnp.exp(-barrier / (R * T))
+
+
+def k_adsorption(T, mass, area):
+    """Collision-theory sticking rate [1/(s*Pa)]
+    (reference rate_constants.py:16-23)."""
+    return area / jnp.sqrt(2.0 * jnp.pi * mass * amutokg * kB * T)
+
+
+def k_desorption(T, mass, area, sigma, inertia, is_polyatomic, des_en):
+    """Desorption from detailed balance with the gas rotational partition
+    function (reference rate_constants.py:26-53).
+
+    Non-linear polyatomic (3 nonzero moments): T^3.5 law over all three
+    rotational temperatures; otherwise linear: T^3 law with the largest
+    moment. ``des_en`` in J/mol.
+    """
+    I = inertia * amuA2tokgm2
+    theta = h**2 / (8.0 * jnp.pi**2 * jnp.where(I > 0, I, 1.0) * kB)
+    theta_prod = jnp.prod(jnp.where(I > 0, theta, 1.0), axis=-1)
+    coeff_poly = (kB**2 * T**3.5 * area * 2.0 * jnp.pi**1.5 *
+                  mass * amutokg) / (h**3 * sigma * theta_prod)
+    I_max = jnp.max(inertia, axis=-1) * amuA2tokgm2
+    theta_lin = h**2 / (8.0 * jnp.pi**2 * jnp.where(I_max > 0, I_max, 1.0) * kB)
+    coeff_lin = (kB**2 * T**3 * area * 2.0 * jnp.pi *
+                 mass * amutokg) / (h**3 * sigma * theta_lin)
+    coeff = jnp.where(is_polyatomic > 0, coeff_poly, coeff_lin)
+    return coeff * jnp.exp(-des_en / (R * T))
+
+
+def keq_thermo(T, rxn_en):
+    """exp(-dG/RT) (reference rate_constants.py:66-73)."""
+    return jnp.exp(-rxn_en / (R * T))
+
+
+def rate_constants(T, *, dGrxn, dErxn, dGa_fwd,
+                   is_arr, is_ads, is_des, is_ghost, reversible,
+                   area, gas_mass, gas_sigma, gas_inertia, gas_polyatomic,
+                   kscale, collision_des: bool = False):
+    """Forward/reverse rate constants for every reaction [n_r].
+
+    Dispatch masks (static, from the spec) reproduce reference
+    reaction.py:118-168:
+    - ``is_arr``: Arrhenius reac_type OR an activated step (TS present /
+      user barrier): kf = (kBT/h)exp(-max(dGa_fwd,0)/RT), kr = kf/Keq.
+    - ``is_ads``: non-activated adsorption: kf = kads; kr by the selected
+      desorption model.
+    - ``is_des``: non-activated desorption: mirror of adsorption.
+    - ``is_ghost``: kf = kr = 0 (energy bookkeeping only).
+    ``reversible`` zeroes kr when 0. ``kscale`` multiplies both kf and kr
+    (the degree-of-rate-control perturbation channel, reference
+    old_system.py:214-217, which preserves Keq).
+
+    Desorption model (``collision_des``):
+    - False (default, 'detailed_balance'): the reverse of adsorption is
+      kads/Keq and the forward of desorption is kads*Keq -- the upstream
+      PyCatKin convention that produced every golden regression value and
+      is exactly detailed-balance consistent with the free-energy
+      landscape.
+    - True ('collision'): the fork's statistical-rate rewrite (reference
+      reaction.py:134-162): desorption uses the rotational partition
+      function formula ``kdes`` with the *electronic* desorption energy.
+      Requires gas moments of inertia.
+
+    Returns (kf, kr, Keq).
+    """
+    pre = prefactor(T)
+    barrier = jnp.maximum(dGa_fwd, 0.0)
+    keq = keq_thermo(T, dGrxn)
+
+    kf_arr = k_arrhenius(T, pre, barrier)
+    kr_arr = kf_arr / keq
+
+    kf_ads = k_adsorption(T, gas_mass, area)
+    if collision_des:
+        kr_ads = k_desorption(T, gas_mass, area, gas_sigma, gas_inertia,
+                              gas_polyatomic, -dErxn)
+        kf_des = k_desorption(T, gas_mass, area, gas_sigma, gas_inertia,
+                              gas_polyatomic, dErxn)
+    else:
+        kr_ads = kf_ads / keq
+        kf_des = k_adsorption(T, gas_mass, area) * keq
+    kr_des = k_adsorption(T, gas_mass, area)
+
+    kf = jnp.where(is_arr > 0, kf_arr,
+                   jnp.where(is_ads > 0, kf_ads,
+                             jnp.where(is_des > 0, kf_des, 0.0)))
+    kr = jnp.where(is_arr > 0, kr_arr,
+                   jnp.where(is_ads > 0, kr_ads,
+                             jnp.where(is_des > 0, kr_des, 0.0)))
+    kf = jnp.where(is_ghost > 0, 0.0, kf)
+    kr = jnp.where(is_ghost > 0, 0.0, kr) * (reversible > 0)
+    return kf * kscale, kr * kscale, keq

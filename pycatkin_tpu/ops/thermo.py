@@ -1,0 +1,99 @@
+"""Vectorized thermochemistry kernels (JAX).
+
+Free-energy contributions for ALL species at once as pure functions of
+(T, p) and static padded arrays -- the TPU-native replacement for the
+reference's per-object lazy evaluation (reference state.py:247-386).
+Units: eV throughout; T in K; p in Pa; frequencies in Hz; masses in amu;
+moments of inertia in amu*A^2.
+
+Shapes: n_s species, F padded vibrational modes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import JtoeV, amuA2tokgm2, amutokg, h, kB
+
+
+def zero_point_energy(freq: jnp.ndarray, fmask: jnp.ndarray) -> jnp.ndarray:
+    """ZPE[eV] = 0.5*h*sum(f) per species (reference state.py:266-287).
+
+    freq: [n_s, F] Hz (padded with zeros); fmask: [n_s, F] 1 for modes that
+    enter the sum (padding and truncated modes excluded).
+    """
+    return 0.5 * h * jnp.sum(freq * fmask, axis=-1) * JtoeV
+
+
+def vibrational_energy(T, freq: jnp.ndarray, fmask: jnp.ndarray) -> jnp.ndarray:
+    """Harmonic vibrational free energy incl. ZPE per species
+    (reference state.py:289-318):
+    Gvibr = ZPE + kB*T*sum(ln(1 - exp(-h*f/kB*T))) [eV].
+
+    Species with no active modes return exactly their (zero) ZPE.
+    """
+    zpe = zero_point_energy(freq, fmask)
+    x = freq * h / (kB * T)
+    # Guard padded slots (f=0 -> log(0)): mask before the log.
+    log_term = jnp.where(fmask > 0, jnp.log1p(-jnp.exp(-jnp.where(fmask > 0, x, 1.0))), 0.0)
+    return zpe + kB * T * jnp.sum(log_term, axis=-1) * JtoeV
+
+
+def translational_energy(T, p, mass: jnp.ndarray, is_gas: jnp.ndarray) -> jnp.ndarray:
+    """Ideal-gas translational free energy per species
+    (reference state.py:320-338):
+    Gtran = -kB*T*ln[(kB*T/p) * (2*pi*m*kB*T/h^2)^1.5] [eV]; 0 for
+    non-gas species.
+    """
+    m_kg = jnp.where(is_gas > 0, mass, 1.0) * amutokg
+    q = (kB * T / p) * (2.0 * jnp.pi * m_kg * kB * T / h**2) ** 1.5
+    return jnp.where(is_gas > 0, -kB * T * jnp.log(q) * JtoeV, 0.0)
+
+
+def rotational_energy(T, inertia: jnp.ndarray, sigma: jnp.ndarray,
+                      is_gas: jnp.ndarray, is_linear: jnp.ndarray) -> jnp.ndarray:
+    """Rigid-rotor rotational free energy per species
+    (reference state.py:340-365). Linear molecules (2 nonzero moments):
+    Gr = -kB*T*ln(8*pi^2*kB*T*I/(sigma*h^2)) with I = sqrt(prod of nonzero
+    moments); non-linear:
+    Gr = -kB*T*ln(sqrt(pi)/sigma * (8*pi^2*kB*T/h^2)^1.5 * sqrt(prod I)).
+    """
+    I_kgm2 = inertia * amuA2tokgm2
+    # linear: geometric mean of the nonzero pair = sqrt(prod over nonzero)
+    prod_nonzero = jnp.prod(jnp.where(I_kgm2 > 0, I_kgm2, 1.0), axis=-1)
+    I_lin = jnp.sqrt(prod_nonzero)
+    q_lin = 8.0 * jnp.pi**2 * kB * T * I_lin / (sigma * h**2)
+    q_nonlin = (jnp.sqrt(jnp.pi) / sigma) * \
+        (8.0 * jnp.pi**2 * kB * T / h**2) ** 1.5 * \
+        jnp.sqrt(jnp.prod(jnp.where(I_kgm2 > 0, I_kgm2, 1.0), axis=-1))
+    g = jnp.where(is_linear > 0, -kB * T * jnp.log(q_lin) * JtoeV,
+                  -kB * T * jnp.log(q_nonlin) * JtoeV)
+    # Gas species without inertia data (their free energy never enters a
+    # reaction) get 0 rather than a NaN that would poison the matmuls.
+    has_inertia = jnp.sum(inertia, axis=-1) > 0
+    return jnp.where((is_gas > 0) & has_inertia, g, 0.0)
+
+
+def thermal_contributions(T, p, *, freq, fmask, mass, sigma, inertia,
+                          is_gas, is_linear, mix,
+                          gvibr0, gvibr_mask, gtran0, gtran_mask,
+                          grota0, grota_mask):
+    """All three thermal free-energy contributions, with input-file
+    overrides and gas-mixture (``gasdata``) corrections applied.
+
+    ``mix`` is an [n_s, n_s] matrix of gas-mixture fractions: row i holds
+    the fraction of gas state j co-adsorbed with species i (reference
+    state.py:335-338,362-365) -- the translational/rotational contributions
+    of those gas states are fraction-weighted onto species i.
+
+    Returns (Gvibr, Gtran, Grota) in eV, each [n_s].
+    """
+    gv = vibrational_energy(T, freq, fmask)
+    gt = translational_energy(T, p, mass, is_gas)
+    gr = rotational_energy(T, inertia, sigma, is_gas, is_linear)
+    gv = jnp.where(gvibr_mask > 0, gvibr0, gv)
+    gt = jnp.where(gtran_mask > 0, gtran0, gt)
+    gr = jnp.where(grota_mask > 0, grota0, gr)
+    gt = gt + mix @ gt
+    gr = gr + mix @ gr
+    return gv, gt, gr

@@ -1,0 +1,2 @@
+from .newton import SolverOptions, SteadyStateResults, solve_steady
+from .ode import ODEOptions, integrate, log_time_grid

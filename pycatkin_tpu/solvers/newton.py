@@ -1,0 +1,180 @@
+"""Steady-state solver: damped Newton with pseudo-transient continuation.
+
+TPU-native replacement for the reference's scipy-based steady-state stack
+(system.py:566-639 ``find_steady`` retry loop, solver.py:223-418
+root/minimize/ode strategies). The solve is a bounded ``lax.while_loop``
+so it jits, vmaps over condition grids, and runs entirely on device.
+
+Strategy (one "attempt"):
+- Pseudo-transient continuation (PTC / switched evolution relaxation):
+  solve (I/dt - J) dx = F(x), x += dx, with dt adapted by the ratio of
+  successive residual norms. dt -> inf recovers Newton; small dt is a
+  damped, globally stabilising step. This is the standard robust scheme
+  for stiff mean-field kinetics.
+- Safeguards per step: non-finite updates shrink dt and are rejected;
+  coverages are clamped to a tiny floor (reference min_tol semantics,
+  system.py:54,328).
+
+Retries (reference system.py:598-635 renormalize-and-retry semantics):
+bounded outer ``lax.while_loop`` over attempts; each retry renormalizes
+|x| onto its conservation groups and restarts PTC from either the
+normalized iterate or a PRNG-keyed random guess (reference
+system.py:586). Per-lane success flags make the whole thing
+vmap-friendly: finished lanes simply stop improving.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SteadyStateResults(NamedTuple):
+    """Steady-state solution + diagnostics (reference system.py:20-30,
+    extended with structured per-solve diagnostics).
+
+    x: full solution vector (gas entries included).
+    success: convergence verdict.
+    residual: max |dy/dt| over dynamic entries at the solution.
+    iterations: total PTC iterations spent.
+    attempts: retries consumed.
+    """
+    x: jnp.ndarray
+    success: jnp.ndarray
+    residual: jnp.ndarray
+    iterations: jnp.ndarray
+    attempts: jnp.ndarray
+
+
+class SolverOptions(NamedTuple):
+    rate_tol: float = 1.0e-8     # residual tolerance on max |dy/dt|
+    coverage_tol: float = 5.0e-2  # allowed deviation of group sums from 1
+    neg_tol: float = 5.0e-3      # allowed negative-coverage excursion
+    dt0: float = 1.0e-9          # initial pseudo-time step
+    dt_max: float = 1.0e20
+    max_steps: int = 200         # PTC iterations per attempt
+    max_attempts: int = 5
+    floor: float = 1.0e-32       # reference min_tol
+
+
+def _normalize(x, groups_dyn, floor):
+    """Renormalize each conservation group of the dynamic vector to sum 1,
+    flooring at ``floor`` (reference system.py:305-328 ``_normalize_y``).
+    Entries outside every group (e.g. CSTR gas unknowns) are untouched.
+    """
+    x = jnp.where(x < floor, floor, x)
+    sums = groups_dyn @ x                      # [n_g]
+    scale = groups_dyn.T @ jnp.where(sums > 0, 1.0 / sums, 1.0)  # [n_dyn]
+    in_group = (groups_dyn.sum(axis=0) > 0)
+    return jnp.where(in_group, x * scale, x)
+
+
+def _ptc_attempt(residual_fn, jac_fn, x0, opts: SolverOptions):
+    """One PTC run from x0; returns (x, residual_norm, steps)."""
+    n = x0.shape[0]
+    eye = jnp.eye(n, dtype=x0.dtype)
+
+    def cond(state):
+        x, dt, fnorm, k = state
+        return (k < opts.max_steps) & (fnorm > opts.rate_tol)
+
+    def body(state):
+        x, dt, fnorm, k = state
+        F = residual_fn(x)
+        J = jac_fn(x)
+        A = eye / dt - J
+        dx = jnp.linalg.solve(A, F)
+        x_new = x + dx
+        F_new = residual_fn(x_new)
+        fnorm_new = jnp.max(jnp.abs(F_new))
+        finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
+        # Accept steps that do not blow the residual up; a mild increase
+        # is tolerated (transient phase of the pseudo-time march).
+        accept = finite & (fnorm_new <= 10.0 * fnorm)
+        # SER with guaranteed geometric growth on accept: plain
+        # residual-ratio SER stalls when dt is tiny (the residual barely
+        # changes, ratio ~ 1, dt never grows). dt -> inf recovers Newton.
+        grow = jnp.maximum(2.0, fnorm / jnp.maximum(fnorm_new, 1e-300))
+        dt_new = jnp.where(accept,
+                           jnp.clip(dt * jnp.minimum(grow, 1.0e6),
+                                    1e-14, opts.dt_max),
+                           dt * 0.25)
+        x_next = jnp.where(accept, x_new, x)
+        fnorm_next = jnp.where(accept, fnorm_new, fnorm)
+        return (x_next, dt_new, fnorm_next, k + 1)
+
+    f0 = jnp.max(jnp.abs(residual_fn(x0)))
+    x, dt, fnorm, k = jax.lax.while_loop(
+        cond, body, (x0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
+    return x, fnorm, k
+
+
+def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
+    """Convergence tests (reference solver.py:69-120 minus the host-only
+    eigenvalue check): residual small, coverages non-negative, each site
+    group sums to ~1."""
+    rate_ok = fnorm <= opts.rate_tol
+    pos_ok = jnp.all(x >= -opts.neg_tol)
+    sums = groups_dyn @ x
+    have_group = groups_dyn.sum(axis=1) > 0
+    sums_ok = jnp.all(jnp.where(have_group,
+                                jnp.abs(sums - 1.0) <= opts.coverage_tol,
+                                True))
+    return rate_ok & pos_ok & sums_ok
+
+
+def solve_steady(residual_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
+                 groups_dyn: jnp.ndarray, opts: SolverOptions,
+                 key: jnp.ndarray | None = None):
+    """Robust steady solve of ``residual_fn(x) = 0`` for the dynamic vector.
+
+    groups_dyn: [n_g, n_dyn] conservation groups restricted to the dynamic
+    indices (used for retry renormalization and the verdict).
+    Returns (x, success, residual, iterations, attempts).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def attempt_cond(state):
+        x, best_x, best_f, success, iters, attempt, key = state
+        return (attempt < opts.max_attempts) & (~success)
+
+    def attempt_body(state):
+        x, best_x, best_f, success, iters, attempt, key = state
+        # Attempt 0 trusts the caller's guess verbatim: even a 1e-9
+        # renormalization perturbs residuals by k_max * 1e-9, and restarts
+        # risk hopping to a different steady-state branch. Attempt 1
+        # renormalizes (reference system.py:630), attempts >= 2 restart
+        # from random guesses (reference system.py:586).
+        x_norm = _normalize(jnp.abs(x), groups_dyn, opts.floor)
+        key, sub = jax.random.split(key)
+        rand = _normalize(jax.random.uniform(sub, x.shape, dtype=x.dtype),
+                          groups_dyn, opts.floor)
+        x_start = jnp.where(attempt == 0, x,
+                            jnp.where(attempt == 1, x_norm, rand))
+        x_new, fnorm, k = _ptc_attempt(residual_fn, jac_fn, x_start, opts)
+        ok = _verdict(x_new, fnorm, groups_dyn, opts)
+        better = fnorm < best_f
+        best_x = jnp.where(better, x_new, best_x)
+        best_f = jnp.where(better, fnorm, best_f)
+        return (x_new, best_x, best_f, ok, iters + k, attempt + 1, key)
+
+    f0 = jnp.max(jnp.abs(residual_fn(x0)))
+    init = (x0, x0, f0, jnp.asarray(False), 0, 0, key)
+    x, best_x, best_f, success, iters, attempts, _ = jax.lax.while_loop(
+        attempt_cond, attempt_body, init)
+    x_out = jnp.where(success, x, best_x)
+    f_out = jnp.where(success, jnp.max(jnp.abs(residual_fn(x))), best_f)
+    return x_out, success, f_out, iters, attempts
+
+
+def jacobian_eigenvalues_stable(jac: jnp.ndarray, pos_tol: float = 1e-2):
+    """Host-side stability check: all Jacobian eigenvalues have real part
+    below ``pos_tol`` (reference solver.py:102-106). Nonsymmetric ``eig``
+    is CPU-only in XLA, so call this outside jit on gathered results."""
+    import numpy as np
+    eig = np.linalg.eigvals(np.asarray(jac))
+    return bool(np.all(eig.real <= pos_tol))

@@ -1,0 +1,38 @@
+"""Test configuration: 8 virtual CPU devices for sharding tests, float64.
+
+Must set XLA flags before jax initializes (hence env manipulation at
+import time, as recommended for host-platform device emulation).
+"""
+
+import os
+
+if os.environ.get("PYCATKIN_TEST_TPU", "0") != "1":
+    # Force the CPU backend: the axon TPU plugin registers itself whenever
+    # PALLAS_AXON_POOL_IPS is set, overriding JAX_PLATFORMS.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    # The axon plugin registers itself in sitecustomize at interpreter
+    # startup (before this file runs), so the env vars alone are not
+    # enough under pytest -- override the backend choice in-config too.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
+
+
+def reference_path(*parts) -> str:
+    return os.path.join(REFERENCE_ROOT, *parts)
+
+
+@pytest.fixture
+def ref_root():
+    if not os.path.isdir(REFERENCE_ROOT):
+        pytest.skip("reference tree not available")
+    return REFERENCE_ROOT

@@ -1,0 +1,64 @@
+"""Golden regression: COOx volcano single point (reference test_2).
+
+Reproduces the reference workflow (test/test_2.py:19-53) through the
+unified API: descriptor energies set on user-defined reactions, scaling
+states resolved by the engine, activity from the transient-settled TOF.
+Golden value: activity(E_CO = E_O = -1 eV, 600 K) = -1.563 +/- 1e-3 eV.
+"""
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from tests.conftest import reference_path
+
+SCOg = 2.0487e-3  # standard entropies (Atkins), eV/K
+SO2g = 2.1261e-3
+
+
+@pytest.fixture
+def volcano_system(ref_root):
+    return pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+
+
+def set_descriptors(sim, ECO, EO):
+    """Per-grid-point descriptor mutation (reference test_2.py:31-49 /
+    cooxvolcano.py:28-46)."""
+    T = sim.params["temperature"]
+    sim.reactions["CO_ads"].dErxn_user = ECO
+    sim.reactions["CO_ads"].dGrxn_user = ECO + SCOg * T
+    sim.reactions["2O_ads"].dErxn_user = 2.0 * EO
+    sim.reactions["2O_ads"].dGrxn_user = 2.0 * EO + SO2g * T
+    gelec = dict(zip(sim.snames, np.asarray(sim.free_energy_table().gelec)))
+    EO2 = gelec["sO2"]
+    sim.reactions["O2_ads"].dErxn_user = EO2
+    sim.reactions["O2_ads"].dGrxn_user = EO2 + SO2g * T
+    sim.reactions["CO_ox"].dEa_fwd_user = max(gelec["SRTS_ox"] - (ECO + EO),
+                                              0.0)
+    sim.reactions["O2_2O"].dEa_fwd_user = max(gelec["SRTS_O2"] - EO2, 0.0)
+    return gelec
+
+
+def test_scaling_state_energies(volcano_system):
+    gelec = set_descriptors(volcano_system, -1.0, -1.0)
+    # Linear scaling relations (reference state.py:490-517):
+    assert gelec["sO2"] == pytest.approx(0.17 + 0.89 * (0.5 * -2.0), abs=1e-12)
+    assert gelec["SRTS_ox"] == pytest.approx(0.02 + 0.7 * (-1.0 + 0.5 * -2.0),
+                                             abs=1e-12)
+    assert gelec["SRTS_O2"] == pytest.approx(1.56 + 1.39 * (0.5 * -2.0),
+                                             abs=1e-12)
+
+
+def test_volcano_point_activity(volcano_system):
+    set_descriptors(volcano_system, -1.0, -1.0)
+    activity = volcano_system.activity(tof_terms=["CO_ox"])
+    assert abs(activity - (-1.563)) <= 1e-3
+
+
+def test_volcano_point_steady_state_matches_transient(volcano_system):
+    set_descriptors(volcano_system, -1.0, -1.0)
+    a_transient = volcano_system.activity(tof_terms=["CO_ox"], ss_solve=False)
+    a_steady = volcano_system.activity(tof_terms=["CO_ox"], ss_solve=True)
+    assert a_steady == pytest.approx(a_transient, abs=5e-3)
+    assert bool(volcano_system.steady_result.success)
