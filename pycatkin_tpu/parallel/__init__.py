@@ -1,0 +1,2 @@
+from .batch import (batch_steady_state, batch_transient, make_mesh,
+                    shard_conditions, stack_conditions, sweep_steady_state)

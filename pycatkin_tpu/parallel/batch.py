@@ -1,0 +1,145 @@
+"""Batched / sharded execution of the engine over condition grids.
+
+The reference runs every sweep as a serial Python loop (temperature sweep
+presets.py:43-64, 2-D volcano grid cooxvolcano.py:22-49, UQ samples
+uncertainty.py:109-112, DRC perturbations old_system.py:503-513). Here a
+sweep is data: a :class:`Conditions` pytree with a leading lane axis.
+One ``vmap`` turns the whole solve into a single XLA program; ``shard_map``
+over a ``jax.sharding.Mesh`` spreads lanes across chips with collectives
+riding ICI. Grid points are physically independent (SURVEY.md §5.7), so
+the only cross-device communication is the result gather.
+
+Per-lane convergence heterogeneity is handled inside the solver
+(bounded retry while_loops with per-lane masks); lanes that finish early
+simply stop improving, which is the price of SIMD execution.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import engine
+from ..frontend.spec import Conditions, ModelSpec
+from ..solvers.newton import SolverOptions
+from ..solvers.ode import ODEOptions
+
+
+def stack_conditions(conds: list[Conditions]) -> Conditions:
+    """Stack per-point Conditions into one lane-batched pytree."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *conds)
+
+
+def broadcast_conditions(cond: Conditions, n: int) -> Conditions:
+    """Repeat one condition n times along a new lane axis."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x),
+                                   (n,) + jnp.asarray(x).shape), cond)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "lanes") -> Mesh:
+    """1-D device mesh over the lane axis. Descriptor/condition lanes are
+    the large, embarrassingly parallel axis of this domain (SURVEY.md
+    §5.7-5.8) -- the honest TPU counterpart of data parallelism."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _pad_lanes(conds: Conditions, multiple: int):
+    """Pad the lane axis to a device-count multiple (lanes are padded with
+    copies of lane 0; callers slice the result back)."""
+    n = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return conds, n
+    def pad(x):
+        return jnp.concatenate([x, jnp.repeat(x[:1], rem, axis=0)], axis=0)
+    return jax.tree_util.tree_map(pad, conds), n
+
+
+def batch_steady_state(spec: ModelSpec, conds: Conditions,
+                       x0: Optional[jnp.ndarray] = None,
+                       opts: SolverOptions = SolverOptions(),
+                       mesh: Optional[Mesh] = None):
+    """Solve the steady state of every lane in one device program.
+
+    conds: lane-batched Conditions; x0: optional [lanes, n_dyn] initial
+    guesses. With a mesh, lanes are sharded across devices.
+    Returns a lane-batched SteadyStateResults.
+    """
+    keys = jax.random.split(
+        jax.random.PRNGKey(0),
+        jax.tree_util.tree_leaves(conds)[0].shape[0])
+
+    def solve_one(cond, key, x0_one):
+        return engine.steady_state(spec, cond, x0=x0_one, key=key, opts=opts)
+
+    vsolve = jax.vmap(solve_one)
+    if mesh is None:
+        return jax.jit(vsolve)(conds, keys, x0)
+
+    n_dev = mesh.devices.size
+    conds_p, n = _pad_lanes(conds, n_dev)
+    keys_p, _ = _pad_lanes(keys, n_dev)
+    x0_p = None
+    if x0 is not None:
+        x0_p, _ = _pad_lanes(x0, n_dev)
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    conds_p = jax.device_put(conds_p, sharding)
+    out = jax.jit(vsolve, out_shardings=sharding)(conds_p, keys_p, x0_p)
+    return jax.tree_util.tree_map(lambda x: x[:n], out)
+
+
+def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
+                    opts: ODEOptions = ODEOptions(),
+                    mesh: Optional[Mesh] = None):
+    """Integrate every lane's transient in one device program.
+    Returns (ys [lanes, t, n_s], ok [lanes])."""
+    def solve_one(cond):
+        return engine.transient(spec, cond, save_ts, opts)
+    vsolve = jax.vmap(solve_one)
+    if mesh is None:
+        return jax.jit(vsolve)(conds)
+    n_dev = mesh.devices.size
+    conds_p, n = _pad_lanes(conds, n_dev)
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    conds_p = jax.device_put(conds_p, sharding)
+    ys, ok = jax.jit(vsolve)(conds_p)
+    return ys[:n], ok[:n]
+
+
+def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
+                       x0=None, opts: SolverOptions = SolverOptions(),
+                       mesh: Optional[Mesh] = None):
+    """Steady state + optional TOF for every lane; the one-call volcano.
+
+    Returns dict with y [lanes, n_s], success [lanes], residual [lanes],
+    and (if tof_mask given) tof [lanes] and activity [lanes].
+    """
+    res = batch_steady_state(spec, conds, x0=x0, opts=opts, mesh=mesh)
+    out = {"y": res.x, "success": res.success, "residual": res.residual,
+           "iterations": res.iterations, "attempts": res.attempts}
+    if tof_mask is not None:
+        def tof_one(cond, y):
+            return engine.tof(spec, cond, y, tof_mask)
+        tofs = jax.jit(jax.vmap(tof_one))(conds, res.x)
+        out["tof"] = tofs
+        out["activity"] = engine.activity_from_tof(
+            tofs, jax.tree_util.tree_leaves(conds.T)[0])
+    return out
+
+
+def shard_conditions(conds: Conditions, mesh: Mesh):
+    """Place a lane-batched Conditions pytree on a mesh (lane-sharded)."""
+    axis = mesh.axis_names[0]
+    return jax.device_put(conds, NamedSharding(mesh, P(axis)))

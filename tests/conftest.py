@@ -31,7 +31,7 @@ def reference_path(*parts) -> str:
     return os.path.join(REFERENCE_ROOT, *parts)
 
 
-@pytest.fixture
+@pytest.fixture(scope="session")
 def ref_root():
     if not os.path.isdir(REFERENCE_ROOT):
         pytest.skip("reference tree not available")
