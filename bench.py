@@ -1,0 +1,147 @@
+"""North-star benchmark: COOx volcano 256x256 descriptor grid.
+
+Solves the steady state + activity of every (E_CO, E_O) grid point as ONE
+batched device program (BASELINE.json north star: <10 s on a v4-8,
+>=100x the scipy baseline). The scipy baseline is measured in-process:
+the same mechanism integrated per point with scipy BDF (the reference's
+solve path, old_system.py:315-383) on a small sample, extrapolated to the
+full grid.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": pts/s, "unit": "points/s", "vs_baseline": x}
+plus human-readable detail on stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+GRID_N = int(os.environ.get("BENCH_GRID_N", "256"))
+BASELINE_SAMPLE = int(os.environ.get("BENCH_BASELINE_SAMPLE", "6"))
+REFERENCE_INPUT = os.environ.get(
+    "PYCATKIN_REFERENCE_INPUT",
+    "/root/reference/examples/COOxVolcano/input.json")
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def scipy_baseline_seconds_per_point(sim, sample_points):
+    """Reference-style per-point solve: scipy BDF transient to the input
+    time span, TOF at the final state (test_2.py workflow). Rate-constant
+    evaluation is excluded from the timing (favors the baseline)."""
+    from scipy.integrate import solve_ivp
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.constants import bartoPa
+    from pycatkin_tpu.models import coox
+
+    spec = sim.spec
+    times = sim.params["times"]
+    is_gas = spec.is_gas.astype(bool)
+    reac_idx = spec.reac_idx
+    prod_idx = spec.prod_idx
+    stoich = spec.stoich
+    is_ads = spec.is_adsorbate
+
+    total = 0.0
+    for (ECO, EO) in sample_points:
+        coox.set_descriptors(sim, float(ECO), float(EO))
+        cond = sim.conditions()
+        kf, kr, _ = engine.rate_constants(spec, cond)
+        kf = np.asarray(kf)
+        kr = np.asarray(kr)
+        y0 = np.asarray(cond.y0, dtype=float)
+
+        def rhs(t, y):
+            y_eff = np.where(is_gas, y * bartoPa, y)
+            y_ext = np.concatenate([y_eff, [1.0]])
+            fwd = kf * np.prod(y_ext[reac_idx], axis=-1)
+            rev = kr * np.prod(y_ext[prod_idx], axis=-1)
+            return (stoich @ (fwd - rev)) * is_ads
+
+        t0 = time.perf_counter()
+        sol = solve_ivp(rhs, (times[0], times[-1]), y0, method="BDF",
+                        rtol=1e-8, atol=1e-10)
+        total += time.perf_counter() - t0
+        if not sol.success:
+            log(f"  baseline point ({ECO:.2f},{EO:.2f}) did not converge")
+    return total / len(sample_points)
+
+
+def main():
+    import jax
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.parallel.batch import sweep_steady_state
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    try:
+        import pycatkin_tpu as pk
+        from pycatkin_tpu.models import coox
+        sim = pk.read_from_input_file(REFERENCE_INPUT)
+        have_ref = True
+    except (OSError, FileNotFoundError):
+        have_ref = False
+
+    if have_ref:
+        be = np.linspace(-2.5, 0.5, GRID_N)
+        conds, shape = coox.volcano_grid_conditions(sim, be)
+        mask = engine.tof_mask_for(sim.spec, ["CO_ox"])
+        spec = sim.spec
+        metric = f"COOx volcano {GRID_N}x{GRID_N} steady-state grid"
+    else:
+        # Self-contained fallback: synthetic network, T x barrier grid.
+        from pycatkin_tpu.models.synthetic import synthetic_system
+        from pycatkin_tpu.parallel.batch import broadcast_conditions
+        sim = synthetic_system(n_species=24, n_reactions=32)
+        spec = sim.spec
+        n = GRID_N * GRID_N
+        conds = broadcast_conditions(sim.conditions(), n)
+        conds = conds._replace(T=np.linspace(400.0, 800.0, n))
+        mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+        metric = f"synthetic {GRID_N}x{GRID_N} steady-state grid"
+
+    n_points = GRID_N * GRID_N
+
+    # Warmup: compile at full shape.
+    t0 = time.perf_counter()
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    jax.block_until_ready(out["y"])
+    compile_and_run = time.perf_counter() - t0
+    log(f"first run (incl. compile): {compile_and_run:.2f} s")
+
+    t0 = time.perf_counter()
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    jax.block_until_ready(out["y"])
+    wall = time.perf_counter() - t0
+    pts_per_s = n_points / wall
+    n_ok = int(np.sum(np.asarray(out["success"])))
+    log(f"batched solve: {wall:.3f} s for {n_points} points "
+        f"({pts_per_s:.0f} pts/s), {n_ok}/{n_points} converged")
+
+    vs_baseline = None
+    if have_ref:
+        rng = np.random.default_rng(0)
+        sample = rng.uniform(-2.5, 0.5, size=(BASELINE_SAMPLE, 2))
+        sec_per_pt = scipy_baseline_seconds_per_point(sim, sample)
+        log(f"scipy baseline: {sec_per_pt*1e3:.1f} ms/point "
+            f"(sample of {BASELINE_SAMPLE})")
+        vs_baseline = (sec_per_pt * n_points) / wall
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(pts_per_s, 2),
+        "unit": "points/s",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline else 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

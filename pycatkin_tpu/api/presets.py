@@ -16,6 +16,7 @@ program per sweep) instead of the reference's serial Python loops.
 from __future__ import annotations
 
 import os
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +32,30 @@ from ..solvers.ode import log_time_grid
 def _ensure_dir(path):
     if path and not os.path.isdir(path):
         os.makedirs(path, exist_ok=True)
+
+
+# Cached jitted sweep programs (jit caches on function identity; building
+# the closures per call would recompile the batched DRC/rates programs on
+# every sweep -- see parallel/batch.py).
+@lru_cache(maxsize=128)
+def _net_rates_program(spec):
+    def net_rates(cond, y):
+        fwd, rev = engine.reaction_rates_at(spec, cond, y)
+        return fwd - rev
+    return jax.jit(jax.vmap(net_rates))
+
+
+@lru_cache(maxsize=128)
+def _drc_program(spec, tof_terms, drc_mode, eps, sopts):
+    if drc_mode == "fd":
+        def drc_one(cond, x0):
+            return engine.drc_fd(spec, cond, list(tof_terms), eps=eps,
+                                 x0=x0, opts=sopts)
+    else:
+        def drc_one(cond, x0):
+            return engine.drc(spec, cond, list(tof_terms), x0=x0,
+                              opts=sopts)
+    return jax.jit(jax.vmap(drc_one))
 
 
 def run(sim_system, steady_state_solve=False, plot_results=False,
@@ -63,6 +88,11 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
     grid = np.asarray(log_time_grid(times[0], times[-1],
                                     sim_system.params.get("n_out", 300)))
     ys, ok = batch_transient(spec, batched, grid, sim_system._ode_options())
+    if not bool(np.all(np.asarray(ok))):
+        bad = [values[i] for i in np.flatnonzero(~np.asarray(ok))]
+        print(f"Warning: transient integration incomplete for sweep "
+              f"values {bad}; downstream results for those lanes are "
+              "unreliable")
     finals = np.asarray(ys[:, -1, :])
 
     if steady_state_solve:
@@ -70,25 +100,21 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
         res = batch_steady_state(spec, batched, x0=x0,
                                  opts=sim_system.solver_options())
         finals = np.asarray(res.x)
+        if not bool(np.all(np.asarray(res.success))):
+            bad = [values[i]
+                   for i in np.flatnonzero(~np.asarray(res.success))]
+            print(f"Warning: steady solve unconverged for sweep values "
+                  f"{bad}")
 
-    def net_rates(cond, y):
-        fwd, rev = engine.reaction_rates_at(spec, cond, y)
-        return fwd - rev
-    rates = np.asarray(jax.jit(jax.vmap(net_rates))(batched,
-                                                    jnp.asarray(finals)))
+    rates = np.asarray(_net_rates_program(spec)(batched,
+                                                jnp.asarray(finals)))
 
     drcs = {}
     if tof_terms is not None:
         x0s = jnp.asarray(finals[:, spec.dynamic_indices])
         sopts = sim_system.solver_options()
-        if drc_mode == "fd":
-            def drc_one(cond, x0):
-                return engine.drc_fd(spec, cond, tof_terms, eps=eps, x0=x0,
-                                     opts=sopts)
-        else:
-            def drc_one(cond, x0):
-                return engine.drc(spec, cond, tof_terms, x0=x0, opts=sopts)
-        xis = np.asarray(jax.jit(jax.vmap(drc_one))(batched, x0s))
+        xis = np.asarray(_drc_program(spec, tuple(tof_terms), drc_mode,
+                                      float(eps), sopts)(batched, x0s))
         for i, v in enumerate(values):
             drcs[v] = dict(zip(spec.rnames, xis[i]))
     return finals, rates, drcs

@@ -28,9 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .constants import JtoeV, R, bartoPa, eVtokJ, h, kB
+from .constants import (JtoeV, LOG_H_OVER_KB, R, bartoPa, eVtokJ, h, kB)
 from .frontend.spec import REACTOR_CSTR, REACTOR_ID, Conditions, ModelSpec
-from .ops import network, rates, thermo
+from .ops import linalg, network, rates, thermo
 from .solvers import newton
 from .solvers.newton import SolverOptions, SteadyStateResults
 from .solvers.ode import ODEOptions, integrate, log_time_grid
@@ -73,7 +73,7 @@ def free_energies(spec: ModelSpec, cond: Conditions) -> FreeEnergies:
         # (reference state.py:490-517 evaluated sequentially).
         b = spec.scl_b + spec.scl_We @ e_full + spec.scl_WuE @ cond.uE_rxn
         n_sc = spec.scl_idx.size
-        e_scl = jnp.linalg.solve(jnp.eye(n_sc) - spec.scl_Ws, b)
+        e_scl = linalg.solve(jnp.eye(n_sc) - spec.scl_Ws, b)
         e_full = e_full.at[spec.scl_idx].set(e_scl)
 
     mods = spec.add0 + cond.eps
@@ -197,6 +197,24 @@ def _dynamic_residual(spec: ModelSpec, cond: Conditions, kf, kr):
     return residual, dyn, y_base
 
 
+def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr):
+    """fscale(x) -> (F, gross) over the dynamic indices: the residual
+    plus the per-species gross-flux scale, computed in one pass (the
+    solver's net-vs-gross convergence measure)."""
+    dyn = jnp.asarray(spec.dynamic_indices)
+    terms = _reactor_terms(spec, cond)
+    static = dict(reac_idx=spec.reac_idx, prod_idx=spec.prod_idx,
+                  is_gas=spec.is_gas, stoich=spec.stoich,
+                  is_adsorbate=spec.is_adsorbate, **terms)
+    y_base = jnp.asarray(cond.y0)
+
+    def fscale(x):
+        y = y_base.at[dyn].set(x)
+        F, gross = network.reactor_rhs_and_scale(y, 0.0, kf, kr, **static)
+        return F[dyn], gross[dyn]
+    return fscale, dyn, y_base
+
+
 def steady_state(spec: ModelSpec, cond: Conditions,
                  x0=None, key=None,
                  opts: SolverOptions = SolverOptions()) -> SteadyStateResults:
@@ -204,13 +222,13 @@ def steady_state(spec: ModelSpec, cond: Conditions,
     for CSTR), gas clamped otherwise -- reference system.py:512-639 /
     old_system.py:385-434 semantics with on-device retry logic."""
     kf, kr, _ = rate_constants(spec, cond)
-    residual, dyn, y_base = _dynamic_residual(spec, cond, kf, kr)
-    jac = jax.jacfwd(residual)
+    fscale, dyn, y_base = _dynamic_fscale(spec, cond, kf, kr)
+    jac = jax.jacfwd(lambda x: fscale(x)[0])
     if x0 is None:
         x0 = y_base[dyn]
     groups_dyn = jnp.asarray(spec.groups)[:, dyn]
     x, success, res, iters, attempts = newton.solve_steady(
-        residual, jac, jnp.asarray(x0), groups_dyn, opts, key=key)
+        fscale, jac, jnp.asarray(x0), groups_dyn, opts, key=key)
     y_full = y_base.at[dyn].set(x)
     return SteadyStateResults(x=y_full, success=success, residual=res,
                               iterations=iters, attempts=attempts)
@@ -237,8 +255,10 @@ def tof(spec: ModelSpec, cond: Conditions, y, tof_mask):
 
 def activity_from_tof(tof_value, T):
     """Activity [eV] = ln(h*TOF/kB*T) * RT (reference
-    old_system.py:517-529)."""
-    return (jnp.log(h * tof_value / (kB * T)) * (R * T)) * 1.0e-3 / eVtokJ
+    old_system.py:517-529). Log-assembled: h*TOF underflows TPU's
+    f32-ranged f64 emulation for small TOF."""
+    log_term = jnp.log(tof_value) + LOG_H_OVER_KB - jnp.log(T)
+    return (log_term * (R * T)) * 1.0e-3 / eVtokJ
 
 
 def tof_mask_for(spec: ModelSpec, tof_terms) -> np.ndarray:
@@ -277,7 +297,7 @@ def make_steady_x(spec: ModelSpec, opts: SolverOptions = SolverOptions(),
     def bwd(saved, xbar):
         x, cond = saved
         J = jax.jacfwd(_residual, argnums=0)(x, cond)
-        w = jnp.linalg.solve(J.T, xbar)
+        w = linalg.solve(J.T, xbar)
         _, vjp_cond = jax.vjp(lambda c: _residual(x, c), cond)
         (cond_bar,) = vjp_cond(-w)
         return (cond_bar,)

@@ -51,10 +51,14 @@ class Conditions(NamedTuple):
     inflow: object       # [n_s] CSTR inflow composition (bar)
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class ModelSpec:
     """Immutable compiled mechanism. All arrays are numpy (static data,
-    closed over by jitted functions -- they become XLA constants)."""
+    closed over by jitted functions -- they become XLA constants).
+
+    ``eq=False``: identity hashing/equality, so a spec can key jit caches
+    (field-wise dataclass equality would compare ndarrays and is
+    meaningless for compiled immutable bundles anyway)."""
 
     # --- species ---
     snames: tuple

@@ -19,6 +19,7 @@ modifiers, and linear scaling relations (incl. ``dereference`` and
 from __future__ import annotations
 
 import os
+import sys
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -96,7 +97,8 @@ class State:
         self.inertia = inertia
         self.shape = int((inertia > 0.0).sum())
         if self.state_type == GAS and self.shape < 2:
-            print(f"state {self.name}: too many zero moments of inertia")
+            print(f"state {self.name}: too many zero moments of inertia",
+                  file=sys.stderr)
 
     def load(self, verbose: bool = False):
         """Resolve electronic energy, frequencies and geometry from sources."""

@@ -1,0 +1,103 @@
+"""Seeded synthetic microkinetic networks for benchmarks and scaling tests.
+
+The reference ships no large mechanism; its biggest network is
+``test/CH4_input.json`` (68 states / 58 reactions). The driver benchmark
+suite (BASELINE.json config 5) additionally calls for a synthetic
+200-species / 500-reaction stiff network batched over condition sweeps.
+This module generates such networks deterministically: a star of
+adsorption steps feeding a random surface reaction graph, with barriers
+drawn over a wide range so rate constants span many decades (the
+stiffness profile of real DFT landscapes).
+
+The generator builds ordinary :class:`State`/:class:`Reaction` objects and
+compiles them through the standard frontend, so benchmarks exercise the
+exact production path (thermo kernels, TS barriers, adsorption kinetics,
+conservation groups), not a shortcut.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api.system import System
+from ..frontend.reactions import (ADSORPTION, ARRHENIUS, Reaction)
+from ..frontend.states import (ADSORBATE, GAS, SURFACE, TS, State)
+from ..models.reactor import InfiniteDilutionReactor
+
+
+def synthetic_system(n_species: int = 200, n_reactions: int = 500,
+                     seed: int = 0, T: float = 500.0, p: float = 1.0e5,
+                     barrier_range: tuple = (0.1, 1.6)) -> System:
+    """Build a random but reproducible mechanism as a :class:`System`.
+
+    ``n_species`` counts solution-vector species (gas + surface +
+    adsorbates); transition states are extra. ``n_reactions`` =
+    adsorption steps (one per gas) + random reversible surface steps.
+    Barriers in ``barrier_range`` eV give rate constants spanning ~15
+    decades at 500 K -- comparable stiffness to the DMTM example.
+    """
+    rng = np.random.default_rng(seed)
+    n_gas = max(2, n_species // 20)
+    n_ads = n_species - n_gas - 1
+    assert n_ads >= n_gas, "n_species too small for the gas count"
+    assert n_reactions > n_gas, "need more reactions than gas species"
+
+    sys = System(T=T, p=p, times=[0.0, 1.0e6])
+    surf = State(name="s", state_type=SURFACE, freq=[], Gelec=0.0)
+    sys.add_state(surf)
+
+    gas_states = []
+    for g in range(n_gas):
+        mass = float(rng.uniform(2.0, 60.0))
+        linear = bool(rng.random() < 0.3)
+        i1, i2, i3 = rng.uniform(2.0, 60.0, size=3)
+        inertia = [i1, i1, 0.0] if linear else [i1, i2, i3]
+        # Distinct gas energies keep the clamped-gas steady state away
+        # from global equilibrium, so cycles carry sustained flux and the
+        # TOF is a meaningful benchmark quantity.
+        st = State(name=f"G{g:03d}", state_type=GAS, mass=mass,
+                   sigma=float(rng.integers(1, 3)), inertia=inertia,
+                   freq=list(rng.uniform(2.0e13, 9.0e13, size=3)),
+                   Gelec=float(rng.uniform(-0.5, 0.5)))
+        sys.add_state(st)
+        gas_states.append(st)
+
+    ads_states = []
+    for a in range(n_ads):
+        st = State(name=f"sA{a:03d}", state_type=ADSORBATE,
+                   freq=list(rng.uniform(1.0e12, 6.0e13, size=3)),
+                   Gelec=float(rng.uniform(-1.2, 0.3)))
+        sys.add_state(st)
+        ads_states.append(st)
+
+    # One non-activated adsorption step per gas, each onto its own site
+    # species: G + s -> sA  (collision-theory kf, detailed-balance kr).
+    for g, gst in enumerate(gas_states):
+        sys.add_reaction(Reaction(
+            name=f"ads{g:03d}", reac_type=ADSORPTION, reversible=True,
+            reactants=[gst, surf], products=[ads_states[g]],
+            area=1.0e-19))
+
+    # Random reversible surface interconversions sX -> sY through a TS
+    # whose electronic energy sits ``barrier`` above the higher end.
+    n_surface_rxns = n_reactions - n_gas
+    for j in range(n_surface_rxns):
+        ia, ib = rng.choice(n_ads, size=2, replace=False)
+        ra, rb = ads_states[ia], ads_states[ib]
+        barrier = float(rng.uniform(*barrier_range))
+        ets = max(ra.Gelec, rb.Gelec) + barrier
+        ts = State(name=f"TS{j:03d}", state_type=TS,
+                   freq=list(rng.uniform(1.0e12, 6.0e13, size=3)),
+                   Gelec=ets)
+        sys.add_state(ts)
+        sys.add_reaction(Reaction(
+            name=f"r{j:03d}", reac_type=ARRHENIUS, reversible=True,
+            reactants=[ra], products=[rb], TS=[ts], area=1.0e-19))
+
+    sys.add_reactor(InfiniteDilutionReactor())
+    start = {"s": 1.0}
+    frac = (p / 1.0e5) / n_gas
+    for gst in gas_states:
+        start[gst.name] = frac
+    sys.params["start_state"] = start
+    return sys

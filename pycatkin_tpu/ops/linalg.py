@@ -1,0 +1,159 @@
+"""Dense linear algebra kernels built from arithmetic ops only.
+
+XLA:TPU provides no float64 LuDecomposition custom call (it implements
+only F32/C64), but double precision is part of this framework's numerical
+contract: stiff microkinetic Jacobians carry rate constants spanning ~30
+decades (SURVEY.md §7.3). These kernels implement LU factorization with
+partial pivoting and triangular solves as plain jnp arithmetic inside
+``lax.fori_loop``, so they compile for any dtype on any backend and
+``vmap`` cleanly over solver lanes.
+
+Systems here are small (n <= a few hundred: species counts, scaling
+states), so the O(n) sequential pivot loop with O(n^2) vectorized row
+updates is the right shape for the TPU -- each update is a fused
+broadcast multiply-add over a [n, n] tile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lu_factor(A: jnp.ndarray):
+    """LU factorization with partial pivoting.
+
+    Returns (LU, perm): LU holds L (unit diagonal, below) and U (on and
+    above the diagonal); perm is the row permutation applied to A.
+    """
+    n = A.shape[-1]
+    idx = jnp.arange(n)
+
+    def body(k, state):
+        A, perm = state
+        col = jnp.abs(A[:, k])
+        col = jnp.where(idx < k, -jnp.inf, col)
+        p = jnp.argmax(col)
+        # Swap rows k and p (and the permutation entries).
+        rk, rp = A[k], A[p]
+        A = A.at[k].set(rp).at[p].set(rk)
+        pk, pp = perm[k], perm[p]
+        perm = perm.at[k].set(pp).at[p].set(pk)
+        # Eliminate below the pivot; store multipliers in column k.
+        pivot = A[k, k]
+        factors = jnp.where(idx > k, A[:, k] / pivot, jnp.zeros_like(pivot))
+        # Update only columns >= k: columns < k hold already-stored L
+        # multipliers and must not be touched by the elimination.
+        upd = jnp.where(idx >= k, A[k], 0.0)
+        A = A - factors[:, None] * upd[None, :]
+        A = A.at[:, k].set(jnp.where(idx > k, factors, A[:, k]))
+        return A, perm
+
+    LU, perm = lax.fori_loop(0, n - 1, body, (A, jnp.arange(n)))
+    return LU, perm
+
+
+def lu_solve(LU: jnp.ndarray, perm: jnp.ndarray, b: jnp.ndarray):
+    """Solve A x = b given lu_factor output. b: [n] or [n, k]."""
+    n = LU.shape[-1]
+    idx = jnp.arange(n)
+    vec = b.ndim == 1
+    y0 = (b[perm, None] if vec else b[perm]).astype(LU.dtype)
+
+    def fwd(i, y):
+        s = jnp.where(idx < i, LU[i], 0.0) @ y
+        return y.at[i].set(y[i] - s)
+
+    def bwd(j, x):
+        i = n - 1 - j
+        s = jnp.where(idx > i, LU[i], 0.0) @ x
+        return x.at[i].set((x[i] - s) / LU[i, i])
+
+    y = lax.fori_loop(0, n, fwd, y0)
+    x = lax.fori_loop(0, n, bwd, y)
+    return x[:, 0] if vec else x
+
+
+# Below this size the O(n) factorization loop is unrolled at trace time:
+# every step becomes static-index arithmetic (one-hot matmul row gathers,
+# no scatters), which XLA fuses into a handful of vectorized TPU ops --
+# crucial when the solve sits inside a vmapped while_loop over 1e4-1e5
+# solver lanes. Larger systems fall back to the fori_loop LU.
+UNROLL_MAX = 48
+_UNROLL_MAX = UNROLL_MAX  # backward-compat alias
+
+
+def make_msolve(M: jnp.ndarray):
+    """Factor M once, return a solve closure reusable for several RHS.
+
+    Encapsulates the small-n/large-n dispatch policy: small systems get
+    an explicit Gauss-Jordan inverse (subsequent solves are matvecs),
+    large ones an LU factorization with triangular solves.
+    """
+    if M.shape[-1] <= UNROLL_MAX:
+        Minv = inv(M)
+        return lambda r: Minv @ r
+    lu, piv = lu_factor(M)
+    return lambda r: lu_solve(lu, piv, r)
+
+
+def _pivot_swap(M, k, idx):
+    """Swap row k with the partial-pivot row, gather-free.
+
+    The pivot row is selected with a one-hot matvec and written back with
+    arithmetic masking, so the whole exchange is mul/add (no dynamic
+    gather/scatter lanes under vmap)."""
+    col = jnp.abs(M[:, k])
+    col = jnp.where(idx < k, -jnp.inf, col)
+    oh_p = (idx == jnp.argmax(col)).astype(M.dtype)
+    row_k = M[k]
+    row_p = oh_p @ M
+    M = M.at[k].set(row_p)                      # static-index update
+    return M - oh_p[:, None] * (row_p - row_k)[None, :]
+
+
+def gauss_solve(A: jnp.ndarray, b: jnp.ndarray):
+    """Partial-pivoted Gauss-Jordan solve, fully unrolled (static n).
+
+    b: [n] or [n, k]. Eliminates above and below the pivot each step, so
+    no triangular substitution pass remains at the end.
+    """
+    n = A.shape[-1]
+    idx = jnp.arange(n)
+    vec = b.ndim == 1
+    # Row equilibration: microkinetic Jacobians carry rows scaled over
+    # ~30 decades; plain partial pivoting then picks by row magnitude
+    # rather than by conditioning and the elimination overflows. Scaling
+    # each row of [A | b] to unit max norm leaves x unchanged and makes
+    # partial pivoting effective.
+    row_max = jnp.max(jnp.abs(A), axis=-1, keepdims=True)
+    r = jnp.where(row_max > 0, 1.0 / row_max, 1.0)
+    M = jnp.concatenate([A * r, (b[:, None] if vec else b) * r], axis=-1)
+    for k in range(n):
+        M = _pivot_swap(M, k, idx)
+        row_k = M[k] / M[k, k]
+        M = M.at[k].set(row_k)
+        factors = jnp.where(idx == k, 0.0, M[:, k])
+        M = M - factors[:, None] * row_k[None, :]
+    x = M[:, n:]
+    return x[:, 0] if vec else x
+
+
+def inv(A: jnp.ndarray) -> jnp.ndarray:
+    """Matrix inverse by unrolled Gauss-Jordan (static n).
+
+    Used where one matrix serves several right-hand sides (the ODE
+    solver's frozen iteration matrix): the subsequent solves collapse to
+    matvecs, which beat sequential triangular substitution on TPU.
+    """
+    n = A.shape[-1]
+    return gauss_solve(A, jnp.eye(n, dtype=A.dtype))
+
+
+def solve(A: jnp.ndarray, b: jnp.ndarray):
+    """Solve A x = b (square, dense) for any dtype on any backend."""
+    if A.shape[-1] <= _UNROLL_MAX:
+        return gauss_solve(A, b)
+    LU, perm = lu_factor(A)
+    return lu_solve(LU, perm, b)

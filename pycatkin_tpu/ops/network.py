@@ -70,6 +70,33 @@ def reactor_rhs(y, t, kf, kr, *, reac_idx, prod_idx, is_gas, stoich,
     return chem * row_scale + flow
 
 
+def reactor_rhs_and_scale(y, t, kf, kr, *, reac_idx, prod_idx, is_gas,
+                          stoich, is_adsorbate, reactor_type,
+                          sigma_over_bar, inv_tau, inflow):
+    """(dy/dt, gross) where ``gross`` is the per-species GROSS flux --
+    |S| @ (fwd + rev) plus |flow| terms -- under the same reactor row
+    transforms as the RHS.
+
+    The gross flux is the convergence yardstick for steady solves: a
+    state is steady when net production is small *relative to gross
+    throughput*; an absolute dy/dt tolerance is unreachable by
+    finite-precision cancellation when gross fluxes are large.
+    """
+    fwd, rev = reaction_rates(y, kf, kr, reac_idx=reac_idx,
+                              prod_idx=prod_idx, is_gas=is_gas)
+    S_abs = jnp.abs(stoich)
+    chem = stoich @ (fwd - rev)
+    # |fwd|,|rev|: off-manifold iterates (negative coverages) can flip
+    # rate signs; the scale must stay a positive flux magnitude.
+    gross = S_abs @ (jnp.abs(fwd) + jnp.abs(rev))
+    if reactor_type == REACTOR_ID:
+        return chem * is_adsorbate, gross * is_adsorbate
+    row_scale = jnp.where(is_adsorbate > 0, 1.0, sigma_over_bar)
+    flow = jnp.where(is_gas > 0, (inflow - y) * inv_tau, 0.0)
+    gflow = jnp.where(is_gas > 0, (inflow + jnp.abs(y)) * inv_tau, 0.0)
+    return chem * row_scale + flow, gross * row_scale + gflow
+
+
 def make_jacobian(rhs_fn):
     """Analytic-by-autodiff Jacobian of an RHS closure: y -> d(rhs)/dy.
 

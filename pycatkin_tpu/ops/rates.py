@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..constants import R, amuA2tokgm2, amutokg, h, kB
+from ..constants import (LOG_DES_LIN, LOG_DES_POLY, R, ROT_THETA_AMU,
+                         SQRT_2PI_AMU_KB, h, kB)
 
 
 def prefactor(T):
@@ -29,8 +30,11 @@ def k_arrhenius(T, prefac, barrier):
 
 def k_adsorption(T, mass, area):
     """Collision-theory sticking rate [1/(s*Pa)]
-    (reference rate_constants.py:16-23)."""
-    return area / jnp.sqrt(2.0 * jnp.pi * mass * amutokg * kB * T)
+    (reference rate_constants.py:16-23).
+
+    area/sqrt(2*pi*m*kB*T) with the SI constant product precomputed
+    host-side (raw m_kg*kB ~6e-49 underflows TPU's f32-ranged f64)."""
+    return area / (SQRT_2PI_AMU_KB * jnp.sqrt(mass * T))
 
 
 def k_desorption(T, mass, area, sigma, inertia, is_polyatomic, des_en):
@@ -39,19 +43,21 @@ def k_desorption(T, mass, area, sigma, inertia, is_polyatomic, des_en):
 
     Non-linear polyatomic (3 nonzero moments): T^3.5 law over all three
     rotational temperatures; otherwise linear: T^3 law with the largest
-    moment. ``des_en`` in J/mol.
+    moment. ``des_en`` in J/mol. Assembled in log space: kB^2/h^3 (~7e53)
+    overflows TPU's f32-ranged f64 emulation.
     """
-    I = inertia * amuA2tokgm2
-    theta = h**2 / (8.0 * jnp.pi**2 * jnp.where(I > 0, I, 1.0) * kB)
-    theta_prod = jnp.prod(jnp.where(I > 0, theta, 1.0), axis=-1)
-    coeff_poly = (kB**2 * T**3.5 * area * 2.0 * jnp.pi**1.5 *
-                  mass * amutokg) / (h**3 * sigma * theta_prod)
-    I_max = jnp.max(inertia, axis=-1) * amuA2tokgm2
-    theta_lin = h**2 / (8.0 * jnp.pi**2 * jnp.where(I_max > 0, I_max, 1.0) * kB)
-    coeff_lin = (kB**2 * T**3 * area * 2.0 * jnp.pi *
-                 mass * amutokg) / (h**3 * sigma * theta_lin)
-    coeff = jnp.where(is_polyatomic > 0, coeff_poly, coeff_lin)
-    return coeff * jnp.exp(-des_en / (R * T))
+    # Rotational temperatures in K from moments in amu*A^2 (in-range).
+    theta = ROT_THETA_AMU / jnp.where(inertia > 0, inertia, 1.0)
+    log_theta_prod = jnp.sum(
+        jnp.where(inertia > 0, jnp.log(theta), 0.0), axis=-1)
+    log_poly = (LOG_DES_POLY + 3.5 * jnp.log(T) +
+                jnp.log(area * mass / sigma) - log_theta_prod)
+    I_max = jnp.max(inertia, axis=-1)
+    theta_lin = ROT_THETA_AMU / jnp.where(I_max > 0, I_max, 1.0)
+    log_lin = (LOG_DES_LIN + 3.0 * jnp.log(T) +
+               jnp.log(area * mass / sigma) - jnp.log(theta_lin))
+    log_coeff = jnp.where(is_polyatomic > 0, log_poly, log_lin)
+    return jnp.exp(log_coeff - des_en / (R * T))
 
 
 def keq_thermo(T, rxn_en):

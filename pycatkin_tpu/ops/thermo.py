@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..constants import JtoeV, amuA2tokgm2, amutokg, h, kB
+from ..constants import (JtoeV, LOG_ROT_CONST, LOG_TRANS_CONST, h, kB)
 
 
 def zero_point_energy(freq: jnp.ndarray, fmask: jnp.ndarray) -> jnp.ndarray:
@@ -44,10 +44,14 @@ def translational_energy(T, p, mass: jnp.ndarray, is_gas: jnp.ndarray) -> jnp.nd
     (reference state.py:320-338):
     Gtran = -kB*T*ln[(kB*T/p) * (2*pi*m*kB*T/h^2)^1.5] [eV]; 0 for
     non-gas species.
+
+    Assembled in log space from the precomputed LOG_TRANS_CONST: the raw
+    2*pi*m_kg*kB (~6e-49) underflows TPU's f32-ranged f64 emulation.
     """
-    m_kg = jnp.where(is_gas > 0, mass, 1.0) * amutokg
-    q = (kB * T / p) * (2.0 * jnp.pi * m_kg * kB * T / h**2) ** 1.5
-    return jnp.where(is_gas > 0, -kB * T * jnp.log(q) * JtoeV, 0.0)
+    m_amu = jnp.where(is_gas > 0, mass, 1.0)
+    log_q = jnp.log(kB * T / p) + 1.5 * (LOG_TRANS_CONST +
+                                         jnp.log(m_amu * T))
+    return jnp.where(is_gas > 0, -kB * T * log_q * JtoeV, 0.0)
 
 
 def rotational_energy(T, inertia: jnp.ndarray, sigma: jnp.ndarray,
@@ -58,16 +62,15 @@ def rotational_energy(T, inertia: jnp.ndarray, sigma: jnp.ndarray,
     moments); non-linear:
     Gr = -kB*T*ln(sqrt(pi)/sigma * (8*pi^2*kB*T/h^2)^1.5 * sqrt(prod I)).
     """
-    I_kgm2 = inertia * amuA2tokgm2
-    # linear: geometric mean of the nonzero pair = sqrt(prod over nonzero)
-    prod_nonzero = jnp.prod(jnp.where(I_kgm2 > 0, I_kgm2, 1.0), axis=-1)
-    I_lin = jnp.sqrt(prod_nonzero)
-    q_lin = 8.0 * jnp.pi**2 * kB * T * I_lin / (sigma * h**2)
-    q_nonlin = (jnp.sqrt(jnp.pi) / sigma) * \
-        (8.0 * jnp.pi**2 * kB * T / h**2) ** 1.5 * \
-        jnp.sqrt(jnp.prod(jnp.where(I_kgm2 > 0, I_kgm2, 1.0), axis=-1))
-    g = jnp.where(is_linear > 0, -kB * T * jnp.log(q_lin) * JtoeV,
-                  -kB * T * jnp.log(q_nonlin) * JtoeV)
+    # All in amu*A^2 with the unit conversion folded into LOG_ROT_CONST:
+    # the raw I_kgm2 (~1e-45) sits at the edge of TPU's f32-ranged f64
+    # emulation. Linear: geometric-mean moment of the nonzero pair.
+    prod_amu = jnp.prod(jnp.where(inertia > 0, inertia, 1.0), axis=-1)
+    log_q_lin = LOG_ROT_CONST + jnp.log(T * jnp.sqrt(prod_amu) / sigma)
+    log_q_nonlin = (0.5 * jnp.log(jnp.pi) - jnp.log(sigma) +
+                    1.5 * (LOG_ROT_CONST + jnp.log(T)) +
+                    0.5 * jnp.log(prod_amu))
+    g = -kB * T * jnp.where(is_linear > 0, log_q_lin, log_q_nonlin) * JtoeV
     # Gas species without inertia data (their free energy never enters a
     # reaction) get 0 rather than a NaN that would poison the matmuls.
     has_inertia = jnp.sum(inertia, axis=-1) > 0

@@ -16,7 +16,7 @@ simply stop improving, which is the price of SIMD execution.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Optional
 
 import jax
@@ -28,6 +28,37 @@ from .. import engine
 from ..frontend.spec import Conditions, ModelSpec
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
+
+
+# ---------------------------------------------------------------------
+# Cached jitted programs. jax.jit caches on function identity, so the
+# vmapped solver closures must be built ONCE per (spec, opts, sharding)
+# -- rebuilding them per call would recompile the whole batched solve
+# every time (tens of seconds at volcano-grid scale). ModelSpec hashes
+# by identity (frozen, eq=False) precisely to key these caches.
+@lru_cache(maxsize=128)
+def _steady_program(spec: ModelSpec, opts: SolverOptions,
+                    out_sharding=None):
+    def solve_one(cond, key, x0):
+        return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts)
+    fn = jax.vmap(solve_one)
+    if out_sharding is not None:
+        return jax.jit(fn, out_shardings=out_sharding)
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=128)
+def _transient_program(spec: ModelSpec, opts: ODEOptions):
+    def solve_one(cond, save_ts):
+        return engine.transient(spec, cond, save_ts, opts)
+    return jax.jit(jax.vmap(solve_one, in_axes=(0, None)))
+
+
+@lru_cache(maxsize=128)
+def _tof_program(spec: ModelSpec):
+    def tof_one(cond, y, mask):
+        return engine.tof(spec, cond, y, mask)
+    return jax.jit(jax.vmap(tof_one, in_axes=(0, 0, None)))
 
 
 def stack_conditions(conds: list[Conditions]) -> Conditions:
@@ -79,12 +110,8 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
         jax.random.PRNGKey(0),
         jax.tree_util.tree_leaves(conds)[0].shape[0])
 
-    def solve_one(cond, key, x0_one):
-        return engine.steady_state(spec, cond, x0=x0_one, key=key, opts=opts)
-
-    vsolve = jax.vmap(solve_one)
     if mesh is None:
-        return jax.jit(vsolve)(conds, keys, x0)
+        return _steady_program(spec, opts)(conds, keys, x0)
 
     n_dev = mesh.devices.size
     conds_p, n = _pad_lanes(conds, n_dev)
@@ -95,7 +122,7 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
     conds_p = jax.device_put(conds_p, sharding)
-    out = jax.jit(vsolve, out_shardings=sharding)(conds_p, keys_p, x0_p)
+    out = _steady_program(spec, opts, sharding)(conds_p, keys_p, x0_p)
     return jax.tree_util.tree_map(lambda x: x[:n], out)
 
 
@@ -104,17 +131,15 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
                     mesh: Optional[Mesh] = None):
     """Integrate every lane's transient in one device program.
     Returns (ys [lanes, t, n_s], ok [lanes])."""
-    def solve_one(cond):
-        return engine.transient(spec, cond, save_ts, opts)
-    vsolve = jax.vmap(solve_one)
+    save_ts = jnp.asarray(save_ts)
     if mesh is None:
-        return jax.jit(vsolve)(conds)
+        return _transient_program(spec, opts)(conds, save_ts)
     n_dev = mesh.devices.size
     conds_p, n = _pad_lanes(conds, n_dev)
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
     conds_p = jax.device_put(conds_p, sharding)
-    ys, ok = jax.jit(vsolve)(conds_p)
+    ys, ok = _transient_program(spec, opts)(conds_p, save_ts)
     return ys[:n], ok[:n]
 
 
@@ -130,9 +155,7 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts}
     if tof_mask is not None:
-        def tof_one(cond, y):
-            return engine.tof(spec, cond, y, tof_mask)
-        tofs = jax.jit(jax.vmap(tof_one))(conds, res.x)
+        tofs = _tof_program(spec)(conds, res.x, jnp.asarray(tof_mask))
         out["tof"] = tofs
         out["activity"] = engine.activity_from_tof(
             tofs, jax.tree_util.tree_leaves(conds.T)[0])

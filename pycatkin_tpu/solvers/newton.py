@@ -31,6 +31,8 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import linalg
+
 
 class SteadyStateResults(NamedTuple):
     """Steady-state solution + diagnostics (reference system.py:20-30,
@@ -50,7 +52,8 @@ class SteadyStateResults(NamedTuple):
 
 
 class SolverOptions(NamedTuple):
-    rate_tol: float = 1.0e-8     # residual tolerance on max |dy/dt|
+    rate_tol: float = 1.0e-8     # absolute residual tolerance on max |dy/dt|
+    rate_tol_rel: float = 1.0e-9  # tolerance relative to the gross-flux scale
     coverage_tol: float = 5.0e-2  # allowed deviation of group sums from 1
     neg_tol: float = 5.0e-3      # allowed negative-coverage excursion
     dt0: float = 1.0e-9          # initial pseudo-time step
@@ -72,24 +75,45 @@ def _normalize(x, groups_dyn, floor):
     return jnp.where(in_group, x * scale, x)
 
 
-def _ptc_attempt(residual_fn, jac_fn, x0, opts: SolverOptions):
-    """One PTC run from x0; returns (x, residual_norm, steps)."""
+def _rnorm(F, gross, opts: SolverOptions):
+    """Normalized residual: max_i |F_i| / (atol + rtol*gross_i) -- the
+    solve is converged when this is <= 1. ``gross`` is the per-species
+    gross flux at the same point (net-vs-gross is the physically
+    meaningful steadiness measure; an absolute dy/dt target is
+    unreachable by cancellation when fluxes are large, in particular
+    under TPU's double-float f64 emulation)."""
+    return jnp.max(jnp.abs(F) / (opts.rate_tol + opts.rate_tol_rel * gross))
+
+
+def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
+    """One PTC run from x0; returns (x, normalized_residual, steps).
+
+    ``fscale_fn(x) -> (F, gross)`` returns the residual and the gross
+    flux scale in one evaluation; both are carried between iterations so
+    each step costs one Jacobian and one fresh evaluation."""
     n = x0.shape[0]
     eye = jnp.eye(n, dtype=x0.dtype)
 
     def cond(state):
-        x, dt, fnorm, k = state
-        return (k < opts.max_steps) & (fnorm > opts.rate_tol)
+        x, F, dt, fnorm, k = state
+        return (k < opts.max_steps) & (fnorm > 1.0)
 
     def body(state):
-        x, dt, fnorm, k = state
-        F = residual_fn(x)
+        x, F, dt, fnorm, k = state
         J = jac_fn(x)
         A = eye / dt - J
-        dx = jnp.linalg.solve(A, F)
-        x_new = x + dx
-        F_new = residual_fn(x_new)
-        fnorm_new = jnp.max(jnp.abs(F_new))
+        dx = linalg.solve(A, F)
+        # Projected PTC: clamp nonnegative AND renormalize conservation
+        # groups (reference min_tol flooring + _normalize_y semantics,
+        # system.py:305-328). Negative coverages flip rate signs and
+        # destabilize the march; a bare clamp alone creates a spurious
+        # absorbing all-zero state (every rate 0 -> residual 0). The
+        # dynamics conserve group sums, so near the manifold this
+        # projection is a no-op to first order.
+        x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
+                           opts.floor)
+        F_new, gross_new = fscale_fn(x_new)
+        fnorm_new = _rnorm(F_new, gross_new, opts)
         finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
         # Accept steps that do not blow the residual up; a mild increase
         # is tolerated (transient phase of the pseudo-time march).
@@ -103,20 +127,22 @@ def _ptc_attempt(residual_fn, jac_fn, x0, opts: SolverOptions):
                                     1e-14, opts.dt_max),
                            dt * 0.25)
         x_next = jnp.where(accept, x_new, x)
+        F_next = jnp.where(accept, F_new, F)
         fnorm_next = jnp.where(accept, fnorm_new, fnorm)
-        return (x_next, dt_new, fnorm_next, k + 1)
+        return (x_next, F_next, dt_new, fnorm_next, k + 1)
 
-    f0 = jnp.max(jnp.abs(residual_fn(x0)))
-    x, dt, fnorm, k = jax.lax.while_loop(
-        cond, body, (x0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
+    F0, gross0 = fscale_fn(x0)
+    f0 = _rnorm(F0, gross0, opts)
+    x, F, dt, fnorm, k = jax.lax.while_loop(
+        cond, body, (x0, F0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
     return x, fnorm, k
 
 
 def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
     """Convergence tests (reference solver.py:69-120 minus the host-only
-    eigenvalue check): residual small, coverages non-negative, each site
-    group sums to ~1."""
-    rate_ok = fnorm <= opts.rate_tol
+    eigenvalue check): normalized residual small, coverages non-negative,
+    each site group sums to ~1."""
+    rate_ok = fnorm <= 1.0
     pos_ok = jnp.all(x >= -opts.neg_tol)
     sums = groups_dyn @ x
     have_group = groups_dyn.sum(axis=1) > 0
@@ -126,14 +152,16 @@ def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
     return rate_ok & pos_ok & sums_ok
 
 
-def solve_steady(residual_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
+def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                  groups_dyn: jnp.ndarray, opts: SolverOptions,
                  key: jnp.ndarray | None = None):
-    """Robust steady solve of ``residual_fn(x) = 0`` for the dynamic vector.
+    """Robust steady solve of ``F(x) = 0`` for the dynamic vector.
 
+    ``fscale_fn(x) -> (F, gross)``: residual plus per-species gross-flux
+    scale (see :func:`_rnorm` for the convergence measure).
     groups_dyn: [n_g, n_dyn] conservation groups restricted to the dynamic
     indices (used for retry renormalization and the verdict).
-    Returns (x, success, residual, iterations, attempts).
+    Returns (x, success, normalized_residual, iterations, attempts).
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -155,19 +183,22 @@ def solve_steady(residual_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                           groups_dyn, opts.floor)
         x_start = jnp.where(attempt == 0, x,
                             jnp.where(attempt == 1, x_norm, rand))
-        x_new, fnorm, k = _ptc_attempt(residual_fn, jac_fn, x_start, opts)
+        x_new, fnorm, k = _ptc_attempt(fscale_fn, jac_fn, x_start,
+                                       groups_dyn, opts)
         ok = _verdict(x_new, fnorm, groups_dyn, opts)
         better = fnorm < best_f
         best_x = jnp.where(better, x_new, best_x)
         best_f = jnp.where(better, fnorm, best_f)
         return (x_new, best_x, best_f, ok, iters + k, attempt + 1, key)
 
-    f0 = jnp.max(jnp.abs(residual_fn(x0)))
+    F0, gross0 = fscale_fn(x0)
+    f0 = _rnorm(F0, gross0, opts)
     init = (x0, x0, f0, jnp.asarray(False), 0, 0, key)
     x, best_x, best_f, success, iters, attempts, _ = jax.lax.while_loop(
         attempt_cond, attempt_body, init)
     x_out = jnp.where(success, x, best_x)
-    f_out = jnp.where(success, jnp.max(jnp.abs(residual_fn(x))), best_f)
+    Fx, grossx = fscale_fn(x)
+    f_out = jnp.where(success, _rnorm(Fx, grossx, opts), best_f)
     return x_out, success, f_out, iters, attempts
 
 

@@ -28,6 +28,8 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import linalg
+
 GAMMA = 2.0 - math.sqrt(2.0)
 D = GAMMA / 2.0
 # 2nd-order solution weights (derived from the two-stage form).
@@ -52,7 +54,7 @@ class ODEOptions(NamedTuple):
     max_factor: float = 8.0
 
 
-def _stage_solve(f, lu, piv, z0, rhs_const, h, scale):
+def _stage_solve(f, msolve, z0, rhs_const, h, scale):
     """Solve z = rhs_const + d*h*f(z) by simplified Newton with the frozen
     factorized iteration matrix (I - d*h*J).
 
@@ -64,7 +66,7 @@ def _stage_solve(f, lu, piv, z0, rhs_const, h, scale):
     def body(_, carry):
         z, _ = carry
         res = z - rhs_const - D * h * f(z)
-        dz = jax.scipy.linalg.lu_solve((lu, piv), res)
+        dz = msolve(res)
         z_new = z - dz
         dz_norm = jnp.sqrt(jnp.mean((dz / scale) ** 2))
         return z_new, dz_norm
@@ -80,25 +82,26 @@ def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions):
     eye = jnp.eye(n, dtype=y.dtype)
     J = jac(y)
     M = eye - D * h * J
-    lu, piv = jax.scipy.linalg.lu_factor(M)
+    # One factorization serves both stages and the error filter.
+    msolve = linalg.make_msolve(M)
 
     f0 = f(y)
     scale0 = opts.atol + opts.rtol * jnp.abs(y)
     # TR stage to t + gamma*h
-    g, conv1 = _stage_solve(f, lu, piv, y + GAMMA * h * f0,
+    g, conv1 = _stage_solve(f, msolve, y + GAMMA * h * f0,
                             y + D * h * f0, h, scale0)
     fg = f(g)
     # BDF2 stage to t + h
     c_g = 1.0 / (GAMMA * (2.0 - GAMMA))
     c_y = (1.0 - GAMMA) ** 2 / (GAMMA * (2.0 - GAMMA))
     rhs_const = c_g * g - c_y * y
-    y1, conv2 = _stage_solve(f, lu, piv, rhs_const + D * h * fg, rhs_const,
+    y1, conv2 = _stage_solve(f, msolve, rhs_const + D * h * fg, rhs_const,
                              h, scale0)
     f1 = f(y1)
 
     # Embedded error, stiffly filtered.
     err_raw = h * ((B1 - BH1) * f0 + (B2 - BH2) * fg + (B3 - BH3) * f1)
-    err = jax.scipy.linalg.lu_solve((lu, piv), err_raw)
+    err = msolve(err_raw)
     scale = opts.atol + opts.rtol * jnp.maximum(jnp.abs(y), jnp.abs(y1))
     err_ratio = jnp.sqrt(jnp.mean((err / scale) ** 2))
     ok = (jnp.isfinite(err_ratio) & jnp.all(jnp.isfinite(y1)) &
