@@ -139,7 +139,9 @@ def main():
         "metric": metric,
         "value": round(pts_per_s, 2),
         "unit": "points/s",
-        "vs_baseline": round(vs_baseline, 2) if vs_baseline else 1.0,
+        # null when no baseline could be measured (no fabricated ratio).
+        "vs_baseline": (round(vs_baseline, 2) if vs_baseline is not None
+                        else None),
     }))
 
 

@@ -25,6 +25,7 @@ from .engine import (FreeEnergies, ReactionEnergies, activity_from_tof, drc,
                      make_rhs, make_steady_x, rate_constants,
                      reaction_energies, reaction_rates_at, steady_state,
                      tof, transient)
+from .analysis.uncertainty import Uncertainty
 from .frontend.loader import read_from_input_file
 from .frontend.reactions import (Reaction, ReactionDerivedReaction,
                                  UserDefinedReaction)

@@ -1,1 +1,4 @@
 from .energy_span import Energy, energy_span_model
+from .grid import (FAIL_CONSERVATION, FAIL_RATE, average_neighborhood,
+                   classify_failures, convergence_heatmap, make_heatmap)
+from .uncertainty import Uncertainty

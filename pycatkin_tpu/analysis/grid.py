@@ -1,0 +1,135 @@
+"""Descriptor-grid triage: failure classification, neighbor repair,
+convergence and activity heatmaps.
+
+Reference capability (/root/reference/pycatkin/functions/analysis.py):
+descriptor scans routinely leave a few percent of grid points
+unconverged; the workflow classifies each failure (site-conservation
+violation vs rate residual, analysis.py:27-76), patches failed points
+with the mean of their converged 8-neighbors (analysis.py:79-116), and
+renders pass/fail plus smoothed log-TOF heatmaps (analysis.py:120-266).
+
+Differences by design:
+- reference ``check_convergence`` re-solves each failed point serially
+  and hardcodes the COOx state names; here classification is vectorized
+  over the already-collected batched diagnostics of ANY mechanism.
+- reference ``average_neighborhood`` returns from inside its scan loop,
+  so only the FIRST failed point is ever patched (analysis.py:116);
+  here every failed point is repaired (documented fix, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FAIL_CONSERVATION = "conservation"
+FAIL_RATE = "rate"
+
+
+def classify_failures(spec, results, coverage_tol: float = 5.0e-2):
+    """Classify each failed lane of a batched SteadyStateResults.
+
+    Returns (labels, detail): labels is a [lanes] object array with None
+    for converged lanes, else FAIL_CONSERVATION (a site group does not
+    sum to ~1 -- reference analysis.py:54-62) or FAIL_RATE (residual
+    target missed, analysis.py:63-70).
+    """
+    x = np.asarray(results.x)
+    ok = np.asarray(results.success).astype(bool)
+    res = np.asarray(results.residual)
+    sums = x @ np.asarray(spec.groups).T              # [lanes, n_g]
+    cons_bad = np.any(np.abs(sums - 1.0) > coverage_tol, axis=-1)
+    labels = np.full(ok.shape, None, dtype=object)
+    labels[~ok & cons_bad] = FAIL_CONSERVATION
+    labels[~ok & ~cons_bad] = FAIL_RATE
+    detail = {
+        "n_failed": int(np.sum(~ok)),
+        "n_conservation": int(np.sum(~ok & cons_bad)),
+        "n_rate": int(np.sum(~ok & ~cons_bad)),
+        "worst_residual": float(np.max(res[~ok])) if np.any(~ok) else 0.0,
+    }
+    return labels, detail
+
+
+def average_neighborhood(values: np.ndarray, success: np.ndarray):
+    """Patch every failed grid point with the mean of its converged
+    8-neighborhood (reference analysis.py:79-116, fixed to repair ALL
+    failed points). NaN values count as failed. Points with no converged
+    neighbor stay unpatched (still flagged failed).
+
+    values: [Ni, Nj]; success: [Ni, Nj] bool.
+    Returns (patched_values, patched_mask): patched_mask marks points
+    that were repaired.
+    """
+    values = np.asarray(values, dtype=float)
+    ok = np.asarray(success, dtype=bool) & np.isfinite(values)
+    out = values.copy()
+    patched = np.zeros_like(ok)
+    Ni, Nj = values.shape
+    bad = np.argwhere(~ok)
+    for i, j in bad:
+        i0, i1 = max(i - 1, 0), min(i + 2, Ni)
+        j0, j1 = max(j - 1, 0), min(j + 2, Nj)
+        nb_ok = ok[i0:i1, j0:j1]
+        if np.any(nb_ok):
+            out[i, j] = np.mean(values[i0:i1, j0:j1][nb_ok])
+            patched[i, j] = True
+    return out, patched
+
+
+def convergence_heatmap(success, x=None, y=None, path=None, ax=None,
+                        xlabel=None, ylabel=None):
+    """Pass/fail grid image (reference analysis.py:120-140)."""
+    import matplotlib.pyplot as plt
+    success = np.asarray(success, dtype=float)
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4, 3.4))
+    extent = None
+    if x is not None and y is not None:
+        extent = [np.min(y), np.max(y), np.min(x), np.max(x)]
+    im = ax.imshow(success, origin="lower", extent=extent, aspect="auto",
+                   cmap="RdYlGn", vmin=0.0, vmax=1.0)
+    ax.set(xlabel=xlabel or "descriptor 2", ylabel=ylabel or "descriptor 1")
+    if created:
+        fig.colorbar(im, ax=ax).ax.set_ylabel("converged")
+        fig.tight_layout()
+        if path:
+            fig.savefig(path, dpi=300)
+        return fig, ax
+    return None, ax
+
+
+def make_heatmap(x, y, panels, labels=None, smooth_sigma: float = 1.0,
+                 log_abs: bool = True, path=None, cmap="RdYlBu_r",
+                 levels: int = 25, xlabel=None, ylabel=None):
+    """Multi-panel Gaussian-smoothed contour maps over a descriptor grid
+    (reference analysis.py:143-266).
+
+    panels: one [Ni, Nj] array or a list of them (e.g. TOF and
+    selectivity); ``log_abs`` renders log10|panel|.
+    """
+    import matplotlib.pyplot as plt
+    from scipy.ndimage import gaussian_filter
+
+    if isinstance(panels, np.ndarray) and panels.ndim == 2:
+        panels = [panels]
+    n = len(panels)
+    fig, axes = plt.subplots(1, n, figsize=(4 * n, 3.4), squeeze=False)
+    for k, panel in enumerate(panels):
+        z = np.asarray(panel, dtype=float)
+        if log_abs:
+            z = np.log10(np.maximum(np.abs(z), 1e-300))
+        if smooth_sigma:
+            z = gaussian_filter(z, sigma=smooth_sigma)
+        ax = axes[0, k]
+        cs = ax.contourf(np.asarray(y), np.asarray(x), z, levels=levels,
+                         cmap=plt.get_cmap(cmap))
+        fig.colorbar(cs, ax=ax).ax.set_ylabel(
+            (labels or [None] * n)[k] or
+            ("log10|value|" if log_abs else "value"))
+        ax.set(xlabel=xlabel or "descriptor 2",
+               ylabel=ylabel or "descriptor 1")
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=300)
+    return fig, axes

@@ -16,6 +16,7 @@ program per sweep) instead of the reference's serial Python loops.
 from __future__ import annotations
 
 import os
+import sys
 from functools import lru_cache
 
 import jax
@@ -92,7 +93,7 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
         bad = [values[i] for i in np.flatnonzero(~np.asarray(ok))]
         print(f"Warning: transient integration incomplete for sweep "
               f"values {bad}; downstream results for those lanes are "
-              "unreliable")
+              "unreliable", file=sys.stderr)
     finals = np.asarray(ys[:, -1, :])
 
     if steady_state_solve:
@@ -104,7 +105,7 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
             bad = [values[i]
                    for i in np.flatnonzero(~np.asarray(res.success))]
             print(f"Warning: steady solve unconverged for sweep values "
-                  f"{bad}")
+                  f"{bad}", file=sys.stderr)
 
     rates = np.asarray(_net_rates_program(spec)(batched,
                                                 jnp.asarray(finals)))

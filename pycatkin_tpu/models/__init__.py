@@ -1,1 +1,2 @@
 from .reactor import CSTReactor, InfiniteDilutionReactor, Reactor
+from .synthetic import synthetic_system

@@ -1,0 +1,3 @@
+from .io import (load_results, save_results, save_state_energy,
+                 save_state_vibrations, save_system_json, system_to_dict)
+from .profiling import profile_trace, run_cprofiler, run_timed

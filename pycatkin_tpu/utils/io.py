@@ -1,0 +1,199 @@
+"""Serialization: model checkpoints and derived-data caches.
+
+The reference persists models by pickling every class
+(state.py:24-29/413-443, reaction.py:18-23, old_system.py:24-29) and
+caches DFT-derived data as ``.dat`` files (state.py:213-245). Pickle is
+replaced here by a *JSON round-trip*: :func:`system_to_dict` serializes a
+System (with all resolved energies/frequencies inlined) back into the
+reference input schema, so the checkpoint is human-readable, diffable and
+loads through the ordinary :func:`read_from_input_file`. The ``.dat``
+writers keep the reference's exact formats so cached files interoperate
+with reference data trees. Sweep results checkpoint as ``.npz`` bundles.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..frontend.reactions import (ReactionDerivedReaction,
+                                  UserDefinedReaction)
+from ..frontend.states import GAS, ScalingState
+from ..models.reactor import CSTReactor, InfiniteDilutionReactor
+
+
+def save_state_energy(state, path: str):
+    """Write ``<energy> eV`` (reference state.py:213-227 save_energy;
+    readable by energy_source='datafile')."""
+    state.load()
+    assert state.Gelec is not None, f"state {state.name} has no energy"
+    with open(path, "w") as fh:
+        fh.write(f"{state.Gelec:.15e} eV\n")
+
+
+def save_state_vibrations(state, path: str):
+    """Write ``i f = <Hz> Hz`` / ``i f/i = <Hz> Hz`` lines (reference
+    state.py:229-245 save_vibrations; readable by
+    freq_source='datafile')."""
+    state.load()
+    with open(path, "w") as fh:
+        k = 0
+        for f in np.asarray(state.freq).ravel():
+            fh.write(f"{k} f = {f:.15e} Hz\n")
+            k += 1
+        for f in np.asarray(state.i_freq if state.i_freq is not None
+                            else []).ravel():
+            fh.write(f"{k} f/i = {f:.15e} Hz\n")
+            k += 1
+
+
+def _state_cfg(st) -> dict:
+    st.load()
+    cfg = {"state_type": st.state_type}
+    if st.sigma is not None:
+        cfg["sigma"] = st.sigma
+    if st.mass is not None:
+        cfg["mass"] = st.mass
+    if st.inertia is not None:
+        cfg["inertia"] = list(np.asarray(st.inertia, dtype=float).ravel())
+    if st.freq is not None and np.asarray(st.freq).size:
+        cfg["freq"] = list(np.asarray(st.freq, dtype=float).ravel())
+        if st.i_freq is not None and np.asarray(st.i_freq).size:
+            cfg["i_freq"] = list(np.asarray(st.i_freq, dtype=float).ravel())
+    for key in ("Gelec", "Gzpe", "Gvibr", "Gtran", "Grota", "Gfree"):
+        val = getattr(st, key)
+        if val is not None:
+            cfg[key] = val
+    if st.add_to_energy:
+        cfg["add_to_energy"] = st.add_to_energy
+    if not st.truncate_freq:
+        cfg["truncate_freq"] = False
+    if st.gasdata is not None:
+        cfg["gasdata"] = {
+            "fraction": list(st.gasdata["fraction"]),
+            "state": [s.name if hasattr(s, "name") else s
+                      for s in st.gasdata["state"]],
+        }
+    if isinstance(st, ScalingState):
+        cfg["scaling_coeffs"] = st.scaling_coeffs
+        cfg["scaling_reactions"] = {
+            key: {"reaction": (e["reaction"].name
+                               if hasattr(e["reaction"], "name")
+                               else e["reaction"]),
+                  **({"multiplicity": e["multiplicity"]}
+                     if "multiplicity" in e else {})}
+            for key, e in st.scaling_reactions.items()}
+        if st.dereference:
+            cfg["dereference"] = True
+        if st.use_descriptor_as_reactant:
+            cfg["use_descriptor_as_reactant"] = True
+    return cfg
+
+
+def _reaction_cfg(rx) -> dict:
+    cfg = {"reac_type": rx.reac_type,
+           "area": rx.area,
+           "reactants": [s.name for s in rx.reactants],
+           "products": [s.name for s in rx.products],
+           "TS": [s.name for s in rx.TS] if rx.TS else None}
+    if not rx.reversible:
+        cfg["reversible"] = False
+    if rx.scaling != 1.0:
+        cfg["scaling"] = rx.scaling
+    if isinstance(rx, ReactionDerivedReaction):
+        cfg["base_reaction"] = rx.base_reaction.name
+    if isinstance(rx, UserDefinedReaction):
+        for key in ("dErxn_user", "dGrxn_user", "dEa_fwd_user",
+                    "dGa_fwd_user", "dEa_rev_user", "dGa_rev_user"):
+            val = getattr(rx, key)
+            if val is not None:
+                cfg[key] = val
+    return cfg
+
+
+def system_to_dict(sim) -> dict:
+    """Serialize a System into the reference input-file schema with all
+    resolved data inlined -- the pickle-replacement checkpoint."""
+    p = sim.params["pressure"]
+    states, scaling = {}, {}
+    for name, st in sim.states.items():
+        (scaling if isinstance(st, ScalingState) else states)[name] = \
+            _state_cfg(st)
+
+    plain, manual, derived = {}, {}, {}
+    for name, rx in sim.reactions.items():
+        cfg = _reaction_cfg(rx)
+        if isinstance(rx, ReactionDerivedReaction):
+            derived[name] = cfg
+        elif isinstance(rx, UserDefinedReaction):
+            manual[name] = cfg
+        else:
+            plain[name] = cfg
+
+    def _unscale_gas(entries):
+        # Stored in bar; the schema holds fractions of total pressure
+        # (loader multiplies by p/1e5, reference load_input.py:50).
+        out = {}
+        for name, val in (entries or {}).items():
+            if sim.states[name].state_type == GAS:
+                out[name] = val / (p / 1.0e5)
+            else:
+                out[name] = val
+        return out
+
+    sys_cfg = {
+        "times": list(sim.params["times"]) if sim.params["times"] else None,
+        "T": sim.params["temperature"],
+        "p": p,
+        "start_state": _unscale_gas(sim.params.get("start_state")),
+        "verbose": sim.params["verbose"],
+        "use_jacobian": sim.params["jacobian"],
+        "rtol": sim.params["rtol"],
+        "atol": sim.params["atol"],
+    }
+    if sim.params.get("inflow_state"):
+        sys_cfg["inflow_state"] = _unscale_gas(sim.params["inflow_state"])
+
+    cfg = {"states": states}
+    if scaling:
+        cfg["scaling relation states"] = scaling
+    cfg["system"] = sys_cfg
+    if plain:
+        cfg["reactions"] = plain
+    if manual:
+        cfg["manual reactions"] = manual
+    if derived:
+        cfg["reaction derived reactions"] = derived
+    if sim.reactor is not None:
+        if isinstance(sim.reactor, CSTReactor):
+            params = {k: v for k, v in sim.reactor.params().items()
+                      if v is not None}
+            cfg["reactor"] = {"CSTReactor": params}
+        else:
+            cfg["reactor"] = "InfiniteDilutionReactor"
+    if sim.energy_landscapes:
+        cfg["energy landscapes"] = {
+            name: {"minima": [[s.name for s in entry]
+                              for entry in lsc.minima],
+                   "labels": list(lsc.labels)}
+            for name, lsc in sim.energy_landscapes.items()}
+    return cfg
+
+
+def save_system_json(sim, path: str):
+    """Checkpoint a System as a reference-schema JSON input file."""
+    with open(path, "w") as fh:
+        json.dump(system_to_dict(sim), fh, indent=1)
+
+
+def save_results(path: str, **arrays):
+    """Checkpoint sweep/grid result arrays as a compressed ``.npz``
+    (replaces the reference's per-run pickle dumps for results)."""
+    np.savez_compressed(path, **{k: np.asarray(v)
+                                 for k, v in arrays.items()})
+
+
+def load_results(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as data:
+        return {k: data[k] for k in data.files}

@@ -1,0 +1,66 @@
+"""Profiling helpers: device-aware timing, XLA traces, cProfile.
+
+TPU-native counterpart of the reference's developer tooling
+(/root/reference/pycatkin/functions/profiling.py: PyCallGraph rendering,
+cProfile wrapper, wall-clock timer). Call-graph rendering is replaced by
+``jax.profiler`` traces (viewable in TensorBoard/XProf), and the timing
+harness blocks on device results so asynchronous dispatch does not fake
+speedups.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import time
+from contextlib import contextmanager
+
+
+def run_timed(fn, *args, repeats: int = 1, warmup: bool = True, **kwargs):
+    """Wall-clock a function with device synchronization (reference
+    profiling.py:49-58, plus ``block_until_ready`` correctness for
+    asynchronously-dispatched JAX computations).
+
+    Returns (result, seconds): ``seconds`` is the best of ``repeats``
+    synchronized runs, excluding the optional warmup (which absorbs
+    compilation).
+    """
+    import jax
+
+    if warmup:
+        jax.block_until_ready(fn(*args, **kwargs))
+    best = float("inf")
+    result = None
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        result = jax.block_until_ready(fn(*args, **kwargs))
+        best = min(best, time.perf_counter() - t0)
+    return result, best
+
+
+@contextmanager
+def profile_trace(log_dir: str):
+    """XLA/TPU profiler trace around a block (replaces the reference's
+    PyCallGraph call-graph PNG, profiling.py:5-34). Inspect with
+    TensorBoard's profile plugin or xprof pointed at ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def run_cprofiler(fn, *args, sort: str = "cumulative", lines: int = 30,
+                  **kwargs):
+    """Host-side cProfile of a callable (reference profiling.py:37-46).
+    Returns (result, report_text)."""
+    prof = cProfile.Profile()
+    prof.enable()
+    result = fn(*args, **kwargs)
+    prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats(sort).print_stats(lines)
+    return result, buf.getvalue()

@@ -1,0 +1,127 @@
+"""CH4 oxidation network: frontend stress test + independent
+thermochemistry oracle.
+
+Mirrors the reference's manual validation script (test/tests.py:20-194),
+which cross-checks State thermochemistry against ASE's HarmonicThermo /
+IdealGasThermo on test/CH4_input.json (12 plain states, 68
+multi-descriptor scaling states, 60 reactions, two surfaces). ASE is not
+available in this environment, so the oracle here is the same statistical
+mechanics written out independently with scipy.constants -- a genuinely
+separate implementation from pycatkin_tpu.ops.thermo (which uses
+log-space forms and the reference's constant set).
+"""
+
+import math
+
+import numpy as np
+import pytest
+import scipy.constants as sc
+
+import pycatkin_tpu as pk
+from tests.conftest import reference_path
+
+EC, EO = 1.5, 0.2  # descriptor energies (reference tests.py:41-44)
+
+
+@pytest.fixture(scope="module")
+def ch4(ref_root):
+    sim = pk.read_from_input_file(reference_path("test", "CH4_input.json"))
+    sim.reactions["C_ads"].dErxn_user = EC
+    sim.reactions["O_ads"].dErxn_user = EO
+    return sim
+
+
+def test_loads_full_network(ch4):
+    spec = ch4.spec
+    assert spec.n_species == 80   # 12 plain + 68 scaling states
+    assert spec.n_reactions == 60
+    assert spec.scl_idx.size == 68
+    # Two site types: s* and h* (reference system.py:224-247 prefix rule)
+    assert spec.groups.shape[0] == 2
+
+
+def test_scaling_state_electronic_energies(ch4):
+    """Multi-descriptor linear relations: Gelec = gC*EC + gO*EO + b
+    (reference tests.py:48-50,100)."""
+    fe = ch4.free_energy_table()
+    gelec = dict(zip(ch4.snames, np.asarray(fe.gelec)))
+    assert gelec["sCO"] == pytest.approx(0.45 * EC + 0.0 * EO + 0.51,
+                                         abs=1e-6)
+    assert gelec["sC-H--OH"] == pytest.approx(0.89 * EC + 0.46 * EO + 0.29,
+                                              abs=1e-6)
+
+
+def _independent_harmonic(freqs_hz, T):
+    """ZPE and harmonic Helmholtz correction from scipy constants
+    (independent of pycatkin_tpu.constants / ops.thermo)."""
+    h_eV = sc.physical_constants["Planck constant in eV/Hz"][0]
+    kT = sc.physical_constants["Boltzmann constant in eV/K"][0] * T
+    zpe = 0.5 * h_eV * sum(freqs_hz)
+    a_corr = zpe + kT * sum(math.log(1.0 - math.exp(-h_eV * f / kT))
+                            for f in freqs_hz)
+    return zpe, a_corr
+
+
+def test_adsorbate_free_energy_vs_independent_oracle(ch4):
+    """Harmonic free energy of sCO and the sC-H--OH TS match the
+    independently computed E + ZPE + kT*sum ln(1-exp(-hf/kT))
+    (reference tests.py:66-103 vs ASE HarmonicThermo)."""
+    T = ch4.params["temperature"]
+    fe = ch4.free_energy_table()
+    gelec = dict(zip(ch4.snames, np.asarray(fe.gelec)))
+    gfree = dict(zip(ch4.snames, np.asarray(fe.gfree)))
+    for name in ("sCO", "sC-H--OH"):
+        st = ch4.states[name]
+        _, a_corr = _independent_harmonic(list(st.used_frequencies()), T)
+        assert gfree[name] - gelec[name] == pytest.approx(a_corr, abs=2e-3)
+
+
+def test_gas_free_energy_vs_independent_oracle(ch4):
+    """O2 translational+rotational free energy against an independent
+    ideal-gas implementation (reference tests.py:105-117 vs ASE
+    IdealGasThermo). Linear molecule, sigma=2."""
+    T = ch4.params["temperature"]
+    p = ch4.params["pressure"]
+    st = ch4.states["O2"]
+    fe = ch4.free_energy_table()
+    i = ch4.snames.index("O2")
+
+    kB_J = sc.k
+    h_J = sc.h
+    JtoeV = 1.0 / sc.e
+    m = st.mass * sc.physical_constants["atomic mass constant"][0]
+    q_t = (kB_J * T / p) * (2 * math.pi * m * kB_J * T / h_J**2) ** 1.5
+    I = max(np.asarray(st.inertia)) * 1.66053906660e-47
+    q_r = 8 * math.pi**2 * kB_J * T * I / (st.sigma * h_J**2)
+    g_ind = -kB_J * T * (math.log(q_t) + math.log(q_r)) * JtoeV
+
+    ours = float(fe.gtran[i] + fe.grota[i])
+    assert ours == pytest.approx(g_ind, rel=2e-3)
+
+
+def test_rate_constant_consistency(ch4):
+    """kf = (kBT/h) exp(-max(dGa,0)/RT) and Keq = exp(-dGr/RT) for an
+    activated step; kr = kf/Keq (reference tests.py:126-194)."""
+    from pycatkin_tpu.constants import R, h, kB
+    T = ch4.params["temperature"]
+    spec = ch4.spec
+    re = ch4.reaction_energy_table()
+    kf, kr, keq = ch4.rate_constant_table()
+    j = spec.rindex("R1")
+    dGa = max(float(re.dGa_fwd[j]), 0.0)
+    dGr = float(re.dGrxn[j])
+    assert kf[j] == pytest.approx(kB * T / h * math.exp(-dGa / (R * T)),
+                                  rel=1e-10)
+    assert keq[j] == pytest.approx(math.exp(-dGr / (R * T)), rel=1e-10)
+    assert kr[j] == pytest.approx(kf[j] / keq[j], rel=1e-10)
+
+
+def test_steady_state_solves(ch4):
+    """Full 80-species / 60-reaction steady solve from the start state
+    (reference tests.py:130 build + find_steady)."""
+    res = ch4.find_steady(use_transient_guess=False)
+    assert bool(res.success)
+    y = np.asarray(res.x)
+    sums = np.asarray(ch4.spec.groups) @ y
+    np.testing.assert_allclose(sums, 1.0, atol=5e-2)
+    assert np.all(y[ch4.spec.dynamic_indices] >= -1e-8)

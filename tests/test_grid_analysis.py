@@ -1,0 +1,70 @@
+"""Descriptor-grid triage tests (reference analysis.py capabilities,
+with the first-point-only repair bug fixed -- SURVEY.md §4)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu.analysis.grid import (FAIL_CONSERVATION, FAIL_RATE,
+                                        average_neighborhood,
+                                        classify_failures,
+                                        convergence_heatmap, make_heatmap)
+
+
+def test_average_neighborhood_patches_all_failures():
+    values = np.arange(25, dtype=float).reshape(5, 5)
+    success = np.ones((5, 5), dtype=bool)
+    success[1, 1] = False
+    success[3, 4] = False
+    values[1, 1] = np.nan
+    patched, mask = average_neighborhood(values, success)
+    assert mask[1, 1] and mask[3, 4], "ALL failed points must be patched"
+    nb = [values[i, j] for i in (0, 1, 2) for j in (0, 1, 2)
+          if (i, j) != (1, 1)]
+    assert patched[1, 1] == pytest.approx(np.mean(nb))
+    assert np.isfinite(patched).all()
+
+
+def test_average_neighborhood_isolated_failure_stays():
+    values = np.zeros((3, 3))
+    success = np.zeros((3, 3), dtype=bool)  # everything failed
+    patched, mask = average_neighborhood(values, success)
+    assert not mask.any()
+
+
+def test_classify_failures():
+    from pycatkin_tpu.solvers.newton import SteadyStateResults
+
+    class SpecStub:
+        groups = np.array([[1.0, 1.0, 0.0]])
+
+    x = np.array([
+        [0.5, 0.5, 0.1],    # converged
+        [0.9, 0.9, 0.0],    # failed, group sums to 1.8 -> conservation
+        [0.6, 0.4, 0.0],    # failed, sums fine -> rate residual
+    ])
+    res = SteadyStateResults(
+        x=x, success=np.array([True, False, False]),
+        residual=np.array([0.1, 2.0, 5.0]),
+        iterations=np.zeros(3), attempts=np.zeros(3))
+    labels, detail = classify_failures(SpecStub(), res)
+    assert labels[0] is None
+    assert labels[1] == FAIL_CONSERVATION
+    assert labels[2] == FAIL_RATE
+    assert detail["n_failed"] == 2
+    assert detail["worst_residual"] == 5.0
+
+
+def test_heatmap_renders(tmp_path):
+    rng = np.random.default_rng(0)
+    x = np.linspace(-2, 0, 8)
+    z = 10.0 ** rng.uniform(-9, 2, size=(8, 8))
+    fig, axes = make_heatmap(x, x, z, path=str(tmp_path / "hm.png"))
+    assert (tmp_path / "hm.png").exists()
+    ok = rng.random((8, 8)) > 0.1
+    fig, ax = convergence_heatmap(ok, x=x, y=x,
+                                  path=str(tmp_path / "conv.png"))
+    assert (tmp_path / "conv.png").exists()

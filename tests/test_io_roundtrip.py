@@ -1,0 +1,86 @@
+"""Checkpoint round-trips: JSON system serialization, .dat caches, npz
+results, and the profiling harness."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.utils import (load_results, run_timed, save_results,
+                                save_state_energy, save_state_vibrations,
+                                save_system_json)
+from tests.conftest import reference_path
+
+
+@pytest.fixture(scope="module")
+def volcano(ref_root):
+    return pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+
+
+def test_system_json_roundtrip_volcano(volcano, tmp_path):
+    """Serialize -> reload -> identical physics (the pickle replacement:
+    reference state.py:24-29 etc.). Activity reproduces the golden value
+    through the checkpoint."""
+    from tests.test_golden_volcano import set_descriptors
+    path = str(tmp_path / "volcano_ckpt.json")
+    save_system_json(volcano, path)
+    sim2 = pk.read_from_input_file(path)
+    assert sorted(sim2.snames) == sorted(volcano.snames)
+    assert set(sim2.reactions) == set(volcano.reactions)
+    set_descriptors(sim2, -1.0, -1.0)
+    assert sim2.activity(tof_terms=["CO_ox"]) == pytest.approx(-1.563,
+                                                               abs=1e-3)
+
+
+def test_system_json_roundtrip_dmtm(ref_root, tmp_path):
+    """DMTM round-trip inlines the .dat-sourced energies/frequencies so
+    the checkpoint is self-contained (no data tree needed)."""
+    sim = pk.read_from_input_file(
+        reference_path("examples", "DMTM", "input.json"))
+    fe1 = sim.free_energy_table(T=600.0)
+    path = str(tmp_path / "dmtm_ckpt.json")
+    save_system_json(sim, path)
+    sim2 = pk.read_from_input_file(path)
+    fe2 = sim2.free_energy_table(T=600.0)
+    i1 = np.argsort(sim.snames)
+    i2 = np.argsort(sim2.snames)
+    np.testing.assert_allclose(np.asarray(fe1.gfree)[i1],
+                               np.asarray(fe2.gfree)[i2], atol=1e-10)
+
+
+def test_state_dat_roundtrip(volcano, tmp_path):
+    from pycatkin_tpu.frontend import parsers
+    from pycatkin_tpu.frontend.states import State
+    st = State(name="x", state_type="adsorbate",
+               freq=[2.0e13, 1.0e13], i_freq=[5.0e12], Gelec=-1.25)
+    epath = str(tmp_path / "x_energy.dat")
+    vpath = str(tmp_path / "x_frequencies.dat")
+    save_state_energy(st, epath)
+    save_state_vibrations(st, vpath)
+    assert parsers.read_energy_dat(epath) == pytest.approx(-1.25)
+    freq, i_freq = parsers.read_frequency_dat(vpath)
+    np.testing.assert_allclose(sorted(freq), [1.0e13, 2.0e13])
+    np.testing.assert_allclose(i_freq, [5.0e12])
+
+
+def test_results_npz_roundtrip(tmp_path):
+    path = str(tmp_path / "grid.npz")
+    save_results(path, activity=np.arange(6.0).reshape(2, 3),
+                 success=np.array([True, False]))
+    data = load_results(path)
+    np.testing.assert_allclose(data["activity"],
+                               np.arange(6.0).reshape(2, 3))
+    assert data["success"].dtype == bool
+
+
+def test_run_timed_blocks():
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(x * x)
+
+    result, seconds = run_timed(f, jnp.arange(1000.0), repeats=2)
+    assert float(result) == pytest.approx(sum(i * i for i in range(1000)))
+    assert seconds >= 0.0

@@ -1,0 +1,64 @@
+"""UQ tests: correlated-noise ensemble semantics + batched execution
+(reference uncertainty.py behavior, test numbers are ours)."""
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.analysis.uncertainty import Uncertainty
+from pycatkin_tpu.frontend.states import ADSORBATE, TS
+from tests.conftest import reference_path
+
+
+@pytest.fixture(scope="module")
+def volcano(ref_root):
+    import tests.test_golden_volcano as gv
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+    gv.set_descriptors(sim, -1.0, -1.0)
+    return sim
+
+
+def test_correlated_noise_structure(volcano):
+    """All adsorbates share one Gaussian draw; every TS noise is that
+    draw scaled by U(0,1) (reference uncertainty.py:34-65)."""
+    uq = Uncertainty(sys=volcano, sigma=0.1, nruns=1, seed=3)
+    noises = uq.get_correlated_state_noises()
+    ads = {n: v for n, v in noises.items()
+           if uq.sys.states[n].state_type == ADSORBATE}
+    ts = {n: v for n, v in noises.items()
+          if uq.sys.states[n].state_type == TS}
+    assert len(set(ads.values())) == 1, "adsorbate noise must be shared"
+    shared = next(iter(ads.values()))
+    for v in ts.values():
+        frac = v / shared
+        assert 0.0 <= frac <= 1.0
+
+
+def test_mean_property_value(volcano):
+    """Batched ensemble: base run is index 0 and noise-free; statistics
+    exclude it; small noise gives activity spread around the base."""
+    uq = Uncertainty(sys=volcano, sigma=0.02, nruns=6, seed=0)
+
+    def activity(sys_view):
+        from pycatkin_tpu import engine
+        cond = sys_view.conditions()
+        mask = engine.tof_mask_for(sys_view.spec, ["CO_ox"])
+        t = engine.tof(sys_view.spec, cond, sys_view.solution[-1], mask)
+        return float(engine.activity_from_tof(t, cond.T))
+
+    values, mean, std = uq.get_mean_property_value(activity)
+    assert values.shape == (7,)
+    assert values[0] == pytest.approx(-1.563, abs=1e-3)  # golden base
+    assert std > 0.0
+    assert abs(mean - values[0]) < 0.5
+
+
+def test_noisy_views_carry_modifiers(volcano):
+    uq = Uncertainty(sys=volcano, sigma=0.05, nruns=2, seed=1)
+    uq.get_noisy_sys_samples()
+    assert uq.noisy_sys[0].states["sCO"].add_to_energy in (None, 0.0)
+    n1 = uq.state_noises[1]
+    for name, val in n1.items():
+        assert uq.noisy_sys[1].states[name].add_to_energy == \
+            pytest.approx(val)
