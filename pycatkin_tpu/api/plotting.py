@@ -230,14 +230,21 @@ def compare_energy_landscapes(sim_systems, landscapes=None, etype="free",
     presets.py:501-556)."""
     fig, ax = plt.subplots(figsize=(10, 4))
     conv = _UNIT_CONV.get(eunits, 1.0)
-    items = []
-    if landscapes is None:
-        for sname, sim in sim_systems.items():
-            for landscape in sim.energy_landscapes.values():
-                items.append((sname, sim, landscape))
+    # Accept one system, a list of systems, or a name->system dict
+    # (the reference examples use all three call styles).
+    if isinstance(sim_systems, dict):
+        sys_items = list(sim_systems.items())
+    elif isinstance(sim_systems, (list, tuple)):
+        sys_items = [(getattr(s, "name", f"system{i}"), s)
+                     for i, s in enumerate(sim_systems)]
     else:
-        for k in landscapes:
-            items.append((k, sim_systems, sim_systems.energy_landscapes[k]))
+        sys_items = [(getattr(sim_systems, "name", "system"), sim_systems)]
+    items = []
+    for sname, sim in sys_items:
+        for lname, landscape in sim.energy_landscapes.items():
+            if landscapes is None or lname in landscapes:
+                label = lname if len(sys_items) == 1 else f"{sname}:{lname}"
+                items.append((label, sim, landscape))
     if cmap is None:
         cmap = plt.get_cmap("tab20", len(items))
     for idx, (label, sim, landscape) in enumerate(items):

@@ -186,6 +186,10 @@ class System:
         reference-style attribute access (state.Gfree etc.) works."""
         fe = engine.free_energies(self.spec, self.conditions(T=T, p=p))
         for i, name in enumerate(self.spec.snames):
+            # Foreign energy-only species (derived-reaction bases from a
+            # donor system) have no entry in self.states.
+            if name not in self.states:
+                continue
             st = self.states[name]
             st.Gelec_computed = float(fe.gelec[i])
             if not st.is_scaling and st.Gelec is None:

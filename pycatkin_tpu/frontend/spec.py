@@ -152,10 +152,10 @@ class ModelSpec:
         return self.rnames.index(name)
 
 
-def _species_counts(states: list, sindex: dict, n_s: int) -> np.ndarray:
+def _species_counts(states: list, oindex, n_s: int) -> np.ndarray:
     row = np.zeros(n_s)
     for s in states:
-        row[sindex[s.name]] += 1.0
+        row[oindex(s)] += 1.0
     return row
 
 
@@ -169,19 +169,50 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     REACTOR_CSTR code; ``reactor_params``: volume/catalyst_area/
     residence_time for CSTR.
     """
-    snames = tuple(sorted(states.keys()))
+    # Foreign energy-states: ReactionDerivedReaction bases may live in a
+    # different system (reference reaction.py:312-334 computes their
+    # energetics from that donor system's State objects). They join the
+    # spec as energy-only species: thermo rows, no dynamics, no
+    # conservation groups. Name collisions with system states get a
+    # '@base' suffix so both energy sources stay distinct.
+    all_states = dict(states)
+    id2name = {id(st): n for n, st in states.items()}
+    for rx in reactions.values():
+        es = rx.energy_states
+        for s in list(es.reactants) + list(es.products) + list(es.TS or []):
+            if id(s) in id2name:
+                continue
+            name = s.name
+            k = 1
+            while name in all_states:
+                name = f"{s.name}@base{k}"
+                k += 1
+            if s.is_scaling:
+                raise NotImplementedError(
+                    f"foreign scaling state {s.name} referenced by "
+                    f"reaction {rx.name}: scaling relations must resolve "
+                    "within one system")
+            all_states[name] = s
+            id2name[id(s)] = name
+
+    snames = tuple(sorted(states.keys()) +
+                   sorted(n for n in all_states if n not in states))
     n_s = len(snames)
     sindex = {n: i for i, n in enumerate(snames)}
+
+    def oindex(st):
+        return sindex[id2name[id(st)]]
+
     rnames = tuple(reactions.keys())
     n_r = len(rnames)
     rindex = {n: i for i, n in enumerate(rnames)}
 
-    for st in states.values():
+    for st in all_states.values():
         st.load()
 
     # ---------------- species arrays ----------------
-    fcounts = [len(states[n].freq) if states[n].freq is not None else 0
-               for n in snames]
+    fcounts = [len(all_states[n].freq) if all_states[n].freq is not None
+               else 0 for n in snames]
     F = max(max(fcounts), 1)
     freq = np.zeros((n_s, F))
     fmask = np.zeros((n_s, F))
@@ -198,7 +229,7 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     state_types = []
 
     for i, name in enumerate(snames):
-        st = states[name]
+        st = all_states[name]
         state_types.append(st.state_type)
         if st.freq is not None and st.freq.size:
             f = np.asarray(st.freq, dtype=float).ravel()
@@ -218,8 +249,10 @@ def build_spec(states: dict, reactions: dict, reactor=None,
                 is_linear[i] = 1.0
         if st.gasdata is not None:
             for frac, gstate in zip(st.gasdata["fraction"], st.gasdata["state"]):
-                gname = gstate.name if isinstance(gstate, State) else gstate
-                mix[i, sindex[gname]] += frac
+                if isinstance(gstate, State):
+                    mix[i, oindex(gstate)] += frac
+                else:
+                    mix[i, sindex[gstate]] += frac
         if st.Gelec is not None:
             gelec0[i] = st.Gelec
         # add_to_energy is deliberately NOT baked into the spec: energy
@@ -233,7 +266,7 @@ def build_spec(states: dict, reactions: dict, reactor=None,
                 override[key][1][i] = 1.0
 
     # ---------------- scaling relations ----------------
-    scl_names = [n for n in snames if states[n].is_scaling]
+    scl_names = [n for n in snames if all_states[n].is_scaling]
     n_sc = len(scl_names)
     scl_pos = {n: j for j, n in enumerate(scl_names)}
     scl_idx = np.array([sindex[n] for n in scl_names], dtype=np.int32)
@@ -249,14 +282,15 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     udar_CuE = np.zeros((n_s, n_r))
     udar_CuG = np.zeros((n_s, n_r))
 
-    def _acc_state(j_row, We, Ws, name, coeff):
+    def _acc_state(j_row, We, Ws, st, coeff):
+        name = id2name[id(st)]
         if name in scl_pos:
             Ws[j_row, scl_pos[name]] += coeff
         else:
             We[j_row, sindex[name]] += coeff
 
     for name in scl_names:
-        st: ScalingState = states[name]
+        st: ScalingState = all_states[name]
         j = scl_pos[name]
         scl_b[j] = float(st.scaling_coeffs["intercept"])
         grads = st.gradients()
@@ -270,13 +304,13 @@ def build_spec(states: dict, reactions: dict, reactor=None,
                 scl_WuE[j, ri] += mult * grad
             else:
                 for s in rx.energy_states.products:
-                    _acc_state(j, scl_We, scl_Ws, s.name, mult * grad)
+                    _acc_state(j, scl_We, scl_Ws, s, mult * grad)
                 for s in rx.energy_states.reactants:
-                    _acc_state(j, scl_We, scl_Ws, s.name, -mult * grad)
+                    _acc_state(j, scl_We, scl_Ws, s, -mult * grad)
             # dereference term: + mult * sum(reactant Gelec)
             if deref:
                 for s in rx.energy_states.reactants:
-                    _acc_state(j, scl_We, scl_Ws, s.name, mult)
+                    _acc_state(j, scl_We, scl_Ws, s, mult)
 
         if st.use_descriptor_as_reactant:
             i = sindex[name]
@@ -291,15 +325,15 @@ def build_spec(states: dict, reactions: dict, reactor=None,
                     udar_CuG[i, ri] += mult
                 else:
                     for s in rx.energy_states.products:
-                        udar_Ce[i, sindex[s.name]] += -mult       # -dE
-                        udar_Cg[i, sindex[s.name]] += mult        # +dG
+                        udar_Ce[i, oindex(s)] += -mult            # -dE
+                        udar_Cg[i, oindex(s)] += mult             # +dG
                     for s in rx.energy_states.reactants:
-                        udar_Ce[i, sindex[s.name]] += mult        # -dE
-                        udar_Cg[i, sindex[s.name]] += -mult       # +dG
+                        udar_Ce[i, oindex(s)] += mult             # -dE
+                        udar_Cg[i, oindex(s)] += -mult            # +dG
                 if deref:
                     for s in rx.energy_states.reactants:
-                        udar_Ce[i, sindex[s.name]] += -mult       # -refE
-                        udar_Cg[i, sindex[s.name]] += mult        # +refG
+                        udar_Ce[i, oindex(s)] += -mult            # -refE
+                        udar_Cg[i, oindex(s)] += mult             # +refG
 
     # ---------------- reactions ----------------
     SR = np.zeros((n_r, n_s))
@@ -333,10 +367,10 @@ def build_spec(states: dict, reactions: dict, reactor=None,
         rx = reactions[rname]
         reac_types.append(rx.reac_type)
         es = rx.energy_states
-        SR[j] = _species_counts(es.reactants, sindex, n_s)
-        SP[j] = _species_counts(es.products, sindex, n_s)
+        SR[j] = _species_counts(es.reactants, oindex, n_s)
+        SP[j] = _species_counts(es.products, oindex, n_s)
         if es.TS is not None:
-            ST_[j] = _species_counts(es.TS, sindex, n_s)
+            ST_[j] = _species_counts(es.TS, oindex, n_s)
             has_TS[j] = 1.0
         reversible[j] = 1.0 if rx.reversible else 0.0
         base_reversible[j] = 1.0 if es.reversible else 0.0
@@ -358,17 +392,17 @@ def build_spec(states: dict, reactions: dict, reactor=None,
                                         np.all(np.abs(vals) > 0.001)) else 0.0
 
         for a, s in enumerate(rx.reactants):
-            reac_idx[j, a] = sindex[s.name]
+            reac_idx[j, a] = oindex(s)
         for a, s in enumerate(rx.products):
-            prod_idx[j, a] = sindex[s.name]
+            prod_idx[j, a] = oindex(s)
         # Weighted stoichiometry (reference old_system.py:239-247): surface
         # rows get +/-scaling, gas rows additionally site_density.
         for s in rx.reactants:
-            i = sindex[s.name]
+            i = oindex(s)
             w = rx.scaling * (rx.site_density if s.state_type == GAS else 1.0)
             stoich[i, j] -= w
         for s in rx.products:
-            i = sindex[s.name]
+            i = oindex(s)
             w = rx.scaling * (rx.site_density if s.state_type == GAS else 1.0)
             stoich[i, j] += w
 
@@ -377,7 +411,7 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     is_gas_dyn = np.zeros(n_s)
     for rx in reactions.values():
         for s in list(rx.reactants) + list(rx.products):
-            i = sindex[s.name]
+            i = oindex(s)
             if s.state_type in (ADSORBATE, SURFACE):
                 is_adsorbate[i] = 1.0
             elif s.state_type == GAS:
@@ -395,22 +429,33 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     # by name prefix, reference system.py:224-247) or, absent explicit
     # surface states, one group with every surface-bound species (the
     # legacy/DMTM convention).
-    surfaces = [n for n in snames if states[n].state_type == SURFACE]
+    # Only SYSTEM states define site groups: foreign energy-only species
+    # (derived-reaction bases) never carry coverage.
+    surfaces = [n for n in snames
+                if n in states and states[n].state_type == SURFACE]
     groups = []
     if surfaces:
         for surf in sorted(surfaces):
             g = np.zeros(n_s)
             g[sindex[surf]] = 1.0
             for n in snames:
-                if (states[n].state_type == ADSORBATE and n[0] == surf
+                if (all_states[n].state_type == ADSORBATE and n[0] == surf
                         and is_adsorbate[sindex[n]]):
                     g[sindex[n]] = 1.0
             groups.append(g)
         covered = np.sum(groups, axis=0)
         leftover = is_adsorbate * (covered == 0)
         if leftover.any():
-            # adsorbates not matched to any surface share one extra group
-            groups.append(leftover)
+            # Adsorbates the name-prefix rule did not associate with any
+            # surface: if exactly one surface matched nothing, they are
+            # its adsorbates (e.g. Butadiene-style '*'/'H*' naming,
+            # where no adsorbate name starts with '*'); otherwise they
+            # share one extra conservation group.
+            lonely = [k for k, g in enumerate(groups) if g.sum() == 1.0]
+            if len(lonely) == 1:
+                groups[lonely[0]] = np.maximum(groups[lonely[0]], leftover)
+            else:
+                groups.append(leftover)
     else:
         groups.append(is_adsorbate.copy())
     groups = np.asarray(groups)

@@ -1,0 +1,95 @@
+"""Butadiene-from-ethanol example: the largest reference mechanism.
+
+Exercises: a 118-state DFT landscape system with 16 energy landscapes
+(input.json), and a 34-species microkinetic model whose 38
+ReactionDerivedReactions borrow energetics from the DFT system via
+``base_system`` (input_mkm.json) -- the reference's production MK
+workflow (examples/Butadiene/butadiene_mkm.py). Also covers
+Butadiene-style site naming ('*', 'H*'), which defeats the name-prefix
+adsorbate association and must fall back to a single site group.
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from tests.conftest import reference_path
+
+
+@pytest.fixture(scope="module")
+def dft_system(ref_root):
+    return pk.read_from_input_file(
+        reference_path("examples", "Butadiene", "input.json"))
+
+
+@pytest.fixture(scope="module")
+def mkm_system(ref_root, dft_system):
+    return pk.read_from_input_file(
+        reference_path("examples", "Butadiene", "input_mkm.json"),
+        base_system=dft_system)
+
+
+def test_dft_system_loads(dft_system):
+    assert len(dft_system.states) == 118
+    assert len(dft_system.energy_landscapes) == 16
+
+
+def test_energy_landscapes_evaluate(dft_system):
+    """Every landscape constructs and the ES model evaluates (reference
+    butadiene.py draws these; energy.py:39-60,238-318)."""
+    name = next(iter(dft_system.energy_landscapes))
+    lsc = dft_system.energy_landscapes[name]
+    tof, espan, tdts, tdi, *_ = lsc.evaluate_energy_span_model(
+        T=723.0, p=101325.0)
+    assert np.isfinite(tof)
+    assert espan > 0
+
+
+def test_compare_energy_landscapes_renders(dft_system, tmp_path):
+    from pycatkin_tpu.api.plotting import compare_energy_landscapes
+    names = [n for n in dft_system.energy_landscapes
+             if "dehydrogenation" in n]
+    assert names, "expected dehydrogenation landscapes"
+    compare_energy_landscapes([dft_system], landscapes=names,
+                              etype="electronic", eunits="eV",
+                              fig_path=str(tmp_path) + "/")
+    import os
+    assert any(f.endswith(".png") for f in os.listdir(tmp_path))
+
+
+def test_mkm_derived_reactions(mkm_system, dft_system):
+    """All 38 derived reactions resolve their base in the DFT system and
+    produce finite rate constants at 723 K."""
+    from pycatkin_tpu.frontend.reactions import ReactionDerivedReaction
+    derived = [r for r in mkm_system.reactions.values()
+               if isinstance(r, ReactionDerivedReaction)]
+    assert len(derived) == 38
+    assert all(r.base_reaction.name in dft_system.reactions
+               for r in derived)
+    kf, kr, keq = mkm_system.rate_constant_table()
+    assert np.all(np.isfinite(kf))
+    assert np.all(np.isfinite(kr))
+    assert np.all(kf >= 0)
+
+
+def test_mkm_star_naming_single_site_group(mkm_system):
+    """'*' surface with 'H*'-style adsorbates: exactly one conservation
+    group holding the empty site and every adsorbate."""
+    spec = mkm_system.spec
+    assert spec.groups.shape[0] == 1
+    g = spec.groups[0]
+    assert g[spec.sindex("*")] == 1.0
+    assert g[spec.sindex("H*")] == 1.0
+    assert int(g.sum()) == len(spec.adsorbate_indices)
+
+
+def test_mkm_steady_state(mkm_system):
+    res = mkm_system.find_steady(use_transient_guess=False)
+    assert bool(res.success)
+    y = np.asarray(res.x)
+    total = float(np.asarray(mkm_system.spec.groups)[0] @ y)
+    assert total == pytest.approx(1.0, abs=5e-2)

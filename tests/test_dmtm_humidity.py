@@ -1,0 +1,55 @@
+"""DMTM humidity example: gas-mixture (``gasdata``) corrections.
+
+Exercises the fraction-weighted co-adsorbed-gas translational/rotational
+free-energy add-ons (reference state.py:335-338,362-365, driven by
+examples/DMTM/humidity/input_humid.json) through the compiled ``mix``
+matrix, plus the wet-data .dat tree parsing.
+"""
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from tests.conftest import reference_path
+
+
+@pytest.fixture(scope="module")
+def humid(ref_root):
+    # Paths inside input_humid.json are relative to examples/DMTM (the
+    # reference runs it from there), not to the humidity subdirectory.
+    return pk.read_from_input_file(
+        reference_path("examples", "DMTM", "humidity", "input_humid.json"),
+        base_path=reference_path("examples", "DMTM"))
+
+
+def test_gasdata_mix_compiled(humid):
+    spec = humid.spec
+    i = spec.sindex("s2OCH4")
+    j_ch4 = spec.sindex("CH4")
+    assert spec.mix[i, j_ch4] == pytest.approx(0.67)
+    iw = spec.sindex("2CuH2O")
+    j_h2o = spec.sindex("H2O")
+    assert spec.mix[iw, j_h2o] == pytest.approx(0.67)
+
+
+def test_gasdata_adds_gas_thermo(humid):
+    """Co-adsorbed species inherit the fraction-weighted gas
+    translational+rotational contributions; a plain adsorbate has none."""
+    fe = humid.free_energy_table(T=500.0)
+    spec = humid.spec
+    i = spec.sindex("s2OCH4")
+    j = spec.sindex("CH4")
+    assert float(fe.gtran[i]) == pytest.approx(0.67 * float(fe.gtran[j]))
+    assert float(fe.grota[i]) == pytest.approx(0.67 * float(fe.grota[j]))
+    i_dry = spec.sindex("sO")
+    assert float(fe.gtran[i_dry]) == 0.0
+
+
+def test_humid_steady_state(humid):
+    humid.solve_odes()
+    res = humid.find_steady()
+    assert bool(res.success)
+    y = np.asarray(res.x)
+    sums = np.asarray(humid.spec.groups) @ y
+    np.testing.assert_allclose(sums, 1.0, atol=5e-2)
