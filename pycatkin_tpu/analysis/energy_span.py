@@ -119,10 +119,10 @@ class Energy:
         return self.energy_landscape
 
     def _landscape_vector(self, T, p, etype="free", verbose=False):
-        if (self.energy_landscape is None or
-                self.energy_landscape["T"] != T or
-                self.energy_landscape["p"] != p):
-            self.construct_energy_landscape(T=T, p=p, verbose=verbose)
+        # Always recompute (reference energy.py:39-60 does the same): a
+        # (T, p)-keyed cache silently serves stale landscapes after
+        # descriptor/user-energy mutation at the same conditions.
+        self.construct_energy_landscape(T=T, p=p, verbose=verbose)
         n = len(self.minima)
         return np.array([self.energy_landscape[etype][i] for i in range(n)])
 

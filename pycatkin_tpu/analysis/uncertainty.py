@@ -14,6 +14,13 @@ device program.
 ``get_mean_property_value`` keeps the reference's callback API (the
 property handle receives a solved system-like object per run) while the
 solves themselves stay batched.
+
+Deliberate divergence from the reference: ``set_correlated_state_noises``
+(reference uncertainty.py:67-96) OVERWRITES any pre-existing energy
+modifier with the noise; here the noise is ADDED on top of baseline
+``add_to_energy`` modifiers (entropy corrections etc.), so systems that
+carry physical baseline modifiers keep them under UQ. For a reference-
+identical ensemble, clear the modifiers before sampling.
 """
 
 from __future__ import annotations

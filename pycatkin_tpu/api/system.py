@@ -33,7 +33,18 @@ class System:
                  T=293.15, p=101325.0, use_jacobian=True,
                  ode_solver="trbdf2", nsteps=1.0e4, rtol=1.0e-8,
                  atol=1.0e-10, xtol=1.0e-8, ftol=1.0e-8, verbose=False,
-                 min_tol=1.0e-32, n_out=300):
+                 min_tol=1.0e-32, n_out=300,
+                 desorption_model="detailed_balance"):
+        # Desorption convention for non-activated ads/des steps:
+        # 'detailed_balance' (upstream, golden-number compatible) or
+        # 'collision' (the fork's statistical kdes rewrite, reference
+        # reaction.py:134-162 + rate_constants.py:26-53). Schema: the
+        # "system" section's "desorption_model" key.
+        if desorption_model not in ("detailed_balance", "collision"):
+            raise ValueError(
+                f"desorption_model must be 'detailed_balance' or "
+                f"'collision', got {desorption_model!r}")
+        self.desorption_model = desorption_model
         # Legacy-compatible parameter dict (reference old_system.py:154-174);
         # sweep drivers mutate these keys directly.
         self.params = {
@@ -126,7 +137,8 @@ class System:
             rtype = self.reactor.reactor_type if self.reactor else None
             rparams = self.reactor.params() if self.reactor else None
             self._spec = build_spec(self.states, self.reactions,
-                                    reactor=rtype, reactor_params=rparams)
+                                    reactor=rtype, reactor_params=rparams,
+                                    desorption_model=self.desorption_model)
         return self
 
     @property
@@ -259,22 +271,51 @@ class System:
 
     def find_steady(self, store_steady=False, y0=None,
                     use_transient_guess=True, key=None,
-                    opts: SolverOptions | None = None) -> SteadyStateResults:
+                    opts: SolverOptions | None = None,
+                    check_stability=True,
+                    pos_jac_tol=1e-2) -> SteadyStateResults:
         """Steady-state solve (union of reference old_system.py:385-468 and
         system.py:566-639). Initial guess priority: explicit y0, then the
         transient tail if available (legacy behavior), then the start
-        state."""
+        state.
+
+        check_stability: reject converged-but-unstable fixed points (all
+        Jacobian eigenvalues must have real part <= pos_jac_tol, reference
+        solver.py:102-106) and retry from random restarts; if no stable
+        state is found the result reports success=False."""
         cond = self.conditions()
+        solver_opts = opts or self.solver_options()
         x0 = None
         if y0 is not None:
             x0 = np.asarray(y0)[self.spec.dynamic_indices]
         elif use_transient_guess and self.solution is not None:
             x0 = self.solution[-1][self.spec.dynamic_indices]
         res = engine.steady_state(self.spec, cond, x0=x0, key=key,
-                                  opts=opts or self.solver_options())
+                                  opts=solver_opts)
+        if check_stability and bool(res.success):
+            import jax
+            k = key if key is not None else jax.random.PRNGKey(1)
+            stable = engine.check_stability(self.spec, cond, res.x,
+                                            pos_tol=pos_jac_tol)
+            for _ in range(3):
+                if stable:
+                    break
+                # Converged onto an unstable branch (e.g. the middle root
+                # of a bistable mechanism): restart from a fresh random
+                # guess, as the reference's verdict-and-retry loop does.
+                k, sub = jax.random.split(k)
+                retry = engine.steady_state(self.spec, cond, key=sub,
+                                            opts=solver_opts)
+                if bool(retry.success):
+                    res = retry
+                    stable = engine.check_stability(self.spec, cond, res.x,
+                                                    pos_tol=pos_jac_tol)
+            if not stable:
+                res = res._replace(success=np.asarray(False))
         self.steady_result = res
-        if store_steady or True:
-            self.full_steady = np.asarray(res.x)
+        # Always stored (the legacy API gates this on store_steady, but
+        # every downstream consumer here reads full_steady).
+        self.full_steady = np.asarray(res.x)
         if self.params["verbose"]:
             print(f"Steady state: success={bool(res.success)} "
                   f"residual={float(res.residual):.3g} "

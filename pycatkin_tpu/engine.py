@@ -230,6 +230,25 @@ def steady_state(spec: ModelSpec, cond: Conditions,
                               iterations=iters, attempts=attempts)
 
 
+def steady_jacobian(spec: ModelSpec, cond: Conditions, x_dyn):
+    """Jacobian of the dynamic residual at x_dyn (the surface-reduced
+    system; reference system.py:547-564 ``_jac_ss``)."""
+    kf, kr, _ = rate_constants(spec, cond)
+    residual, _, _ = _dynamic_residual(spec, cond, kf, kr)
+    return jax.jacfwd(residual)(jnp.asarray(x_dyn))
+
+
+def check_stability(spec: ModelSpec, cond: Conditions, y_full,
+                    pos_tol: float = 1e-2) -> bool:
+    """Jacobian-eigenvalue stability verdict for one steady state
+    (reference solver.py:102-106): every eigenvalue's real part must lie
+    below ``pos_tol``. Nonsymmetric ``eig`` is host-only in XLA, so this
+    runs outside jit on the gathered solution."""
+    dyn = jnp.asarray(spec.dynamic_indices)
+    J = steady_jacobian(spec, cond, jnp.asarray(y_full)[dyn])
+    return newton.jacobian_eigenvalues_stable(J, pos_tol)
+
+
 def transient(spec: ModelSpec, cond: Conditions, save_ts,
               opts: ODEOptions = ODEOptions()):
     """Integrate the reactor ODEs over ``save_ts`` (reference

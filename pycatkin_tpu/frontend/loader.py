@@ -64,6 +64,18 @@ def read_from_input_file(input_path="input.json", base_system=None,
                 scfg[key] = _resolve_path(scfg[key])
         states[name] = ScalingState(name=name, **scfg)
 
+    # Checkpoint extension: energy-only donor states for 'base reactions'
+    # (written by utils.io.system_to_dict for derived-reaction systems
+    # whose bases live in a donor system). NOT added to the system -- they
+    # only carry the borrowed energetics.
+    base_states: dict[str, State] = {}
+    for name, scfg in cfg.get("base states", {}).items():
+        scfg = dict(scfg)
+        for key in ("path", "vibs_path"):
+            if key in scfg:
+                scfg[key] = _resolve_path(scfg[key])
+        base_states[name] = State(name=name, **scfg)
+
     if "system" not in cfg:
         raise RuntimeError("Input file contains no system details.")
     sys_params = dict(cfg["system"])
@@ -89,26 +101,72 @@ def read_from_input_file(input_path="input.json", base_system=None,
         if st.gasdata is not None:
             st.gasdata["state"] = [states[s] for s in st.gasdata["state"]]
         sim.add_state(st)
+    for st in base_states.values():
+        if st.gasdata is not None:
+            st.gasdata["state"] = [base_states.get(s) or states[s]
+                                   for s in st.gasdata["state"]]
 
     reactions: dict[str, Reaction] = {}
 
-    def _wire(rx_cfg):
+    def _wire(rx_cfg, pool=states):
         rx_cfg = dict(rx_cfg)
-        rx_cfg["reactants"] = [states[s] for s in rx_cfg["reactants"]]
-        rx_cfg["products"] = [states[s] for s in rx_cfg["products"]]
+        rx_cfg["reactants"] = [pool[s] for s in rx_cfg["reactants"]]
+        rx_cfg["products"] = [pool[s] for s in rx_cfg["products"]]
         if rx_cfg.get("TS") is not None:
-            rx_cfg["TS"] = [states[s] for s in rx_cfg["TS"]]
+            rx_cfg["TS"] = [pool[s] for s in rx_cfg["TS"]]
         return rx_cfg
 
     for name, rcfg in cfg.get("reactions", {}).items():
         reactions[name] = Reaction(name=name, **_wire(rcfg))
     for name, rcfg in cfg.get("manual reactions", {}).items():
         reactions[name] = UserDefinedReaction(name=name, **_wire(rcfg))
+
+    # Checkpoint extension: donor reactions resolved against base states
+    # first; kept out of the system's kinetics (energy donors only).
+    # A donor may itself be user-defined (user-energy keys in its cfg) or
+    # derived from another donor ('base_reaction' key; second pass).
+    donor_reactions: dict[str, Reaction] = {}
+    if cfg.get("base reactions"):
+        pool = {**states, **base_states}
+        deferred = {}
+        for name, rcfg in cfg["base reactions"].items():
+            if "base_reaction" in rcfg:
+                deferred[name] = rcfg
+            elif any(k.endswith("_user") for k in rcfg):
+                donor_reactions[name] = UserDefinedReaction(
+                    name=name, **_wire(rcfg, pool))
+            else:
+                donor_reactions[name] = Reaction(name=name,
+                                                 **_wire(rcfg, pool))
+        while deferred:
+            # A donor may be derived from another donor OR from one of
+            # the system's own reactions (both sections parsed above).
+            donors = {**reactions, **donor_reactions}
+            resolvable = [n for n, rc in deferred.items()
+                          if rc["base_reaction"] in donors]
+            if not resolvable:
+                raise KeyError(
+                    f"base reactions {sorted(deferred)} reference donors "
+                    "absent from the checkpoint")
+            for name in resolvable:
+                rcfg = _wire(deferred.pop(name), pool)
+                bname = rcfg.pop("base_reaction")
+                donor_reactions[name] = ReactionDerivedReaction(
+                    name=name, base_reaction=donors[bname], **rcfg)
+
     if "reaction derived reactions" in cfg:
-        donor = base_system.reactions if base_system is not None else reactions
+        if base_system is not None:
+            donor = base_system.reactions
+        else:
+            donor = {**reactions, **donor_reactions}
         for name, rcfg in cfg["reaction derived reactions"].items():
             rcfg = _wire(rcfg)
             base_name = rcfg.pop("base_reaction")
+            if base_name not in donor:
+                raise KeyError(
+                    f"derived reaction {name}: base reaction {base_name!r} "
+                    "not found -- supply base_system= or load a checkpoint "
+                    "with inlined 'base reactions'")
             reactions[name] = ReactionDerivedReaction(
                 name=name, base_reaction=donor[base_name], **rcfg)
 

@@ -79,11 +79,29 @@ class Reaction:
 
 def _resolve_user_value(value, T: float):
     """User energies may be scalars or dicts keyed by temperature
-    (reference reaction.py:228-260)."""
+    (reference reaction.py:228-260).
+
+    The reference KeyErrors on any swept T absent from the dict; here
+    intermediate temperatures are linearly interpolated (sweeps like
+    run_temperatures otherwise cannot cross a per-T dict), while
+    temperatures outside the tabulated range raise a clear error."""
     if value is None:
         return None
     if isinstance(value, dict):
-        return value[T] if T in value else value[float(T)]
+        T = float(T)
+        table = {float(k): float(v) for k, v in value.items()}
+        if T in table:
+            return table[T]
+        keys = sorted(table)
+        if T < keys[0] or T > keys[-1]:
+            raise ValueError(
+                f"user energy tabulated for T in [{keys[0]}, {keys[-1]}] K "
+                f"only; cannot extrapolate to T={T} K")
+        import bisect
+        hi = bisect.bisect_left(keys, T)
+        lo, hi = keys[hi - 1], keys[hi]
+        w = (T - lo) / (hi - lo)
+        return (1.0 - w) * table[lo] + w * table[hi]
     return float(value)
 
 

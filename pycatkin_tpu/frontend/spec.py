@@ -21,12 +21,10 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from ..ops.network import REACTOR_CSTR, REACTOR_ID
 from .reactions import (ADSORPTION, ARRHENIUS, DESORPTION, GHOST, Reaction,
                         UserDefinedReaction)
 from .states import ADSORBATE, GAS, SURFACE, TS, ScalingState, State
-
-REACTOR_ID = 0
-REACTOR_CSTR = 1
 
 
 class Conditions(NamedTuple):
@@ -270,7 +268,6 @@ def build_spec(states: dict, reactions: dict, reactor=None,
     n_sc = len(scl_names)
     scl_pos = {n: j for j, n in enumerate(scl_names)}
     scl_idx = np.array([sindex[n] for n in scl_names], dtype=np.int32)
-    scl_b = np.zeros(max(n_sc, 1))[:n_sc]
     scl_b = np.zeros(n_sc)
     scl_We = np.zeros((n_sc, n_s))
     scl_Ws = np.zeros((n_sc, n_sc))
@@ -447,14 +444,31 @@ def build_spec(states: dict, reactions: dict, reactor=None,
         leftover = is_adsorbate * (covered == 0)
         if leftover.any():
             # Adsorbates the name-prefix rule did not associate with any
-            # surface: if exactly one surface matched nothing, they are
-            # its adsorbates (e.g. Butadiene-style '*'/'H*' naming,
-            # where no adsorbate name starts with '*'); otherwise they
-            # share one extra conservation group.
+            # surface: with exactly ONE surface in the system they must be
+            # its adsorbates (e.g. Butadiene-style '*'/'H*' naming, where
+            # no adsorbate name starts with '*'). With multiple surfaces
+            # but exactly one that matched nothing, assume (and warn, so a
+            # mis-assignment is visible) that the leftovers are its
+            # adsorbates; otherwise the association is ambiguous and they
+            # get their own conservation group, with a warning.
+            names = [snames[i] for i in np.flatnonzero(leftover)]
             lonely = [k for k, g in enumerate(groups) if g.sum() == 1.0]
-            if len(lonely) == 1:
+            if len(surfaces) == 1:
+                groups[0] = np.maximum(groups[0], leftover)
+            elif len(lonely) == 1:
+                import warnings
+                warnings.warn(
+                    f"adsorbates {names} match no surface by name prefix; "
+                    f"assuming they occupy {sorted(surfaces)[lonely[0]]!r} "
+                    "(the only surface with no prefix-matched adsorbates)",
+                    stacklevel=2)
                 groups[lonely[0]] = np.maximum(groups[lonely[0]], leftover)
             else:
+                import warnings
+                warnings.warn(
+                    f"adsorbates {names} match no surface by name prefix "
+                    f"(surfaces: {sorted(surfaces)}); giving them their own "
+                    "site-conservation group", stacklevel=2)
                 groups.append(leftover)
     else:
         groups.append(is_adsorbate.copy())

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..constants import bartoPa
 
-# Reactor type codes.
+# Reactor type codes (canonical definition; frontend.spec re-exports).
 REACTOR_ID = 0
 REACTOR_CSTR = 1
 

@@ -36,7 +36,20 @@ from ..solvers.ode import ODEOptions
 # -- rebuilding them per call would recompile the whole batched solve
 # every time (tens of seconds at volcano-grid scale). ModelSpec hashes
 # by identity (frozen, eq=False) precisely to key these caches.
-@lru_cache(maxsize=128)
+#
+# Identity keys mean entries for dead specs can never be re-hit, and each
+# pins its spec + compiled executable; the size is kept small and
+# :func:`clear_program_caches` lets long-running sessions (one System per
+# UQ copy, loops over mechanisms) release device memory explicitly.
+def clear_program_caches():
+    """Drop all cached jitted programs (and their spec references)."""
+    _steady_program.cache_clear()
+    _transient_program.cache_clear()
+    _tof_program.cache_clear()
+    _jacobian_program.cache_clear()
+
+
+@lru_cache(maxsize=16)
 def _steady_program(spec: ModelSpec, opts: SolverOptions,
                     out_sharding=None):
     def solve_one(cond, key, x0):
@@ -47,14 +60,14 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
     return jax.jit(fn)
 
 
-@lru_cache(maxsize=128)
+@lru_cache(maxsize=16)
 def _transient_program(spec: ModelSpec, opts: ODEOptions):
     def solve_one(cond, save_ts):
         return engine.transient(spec, cond, save_ts, opts)
     return jax.jit(jax.vmap(solve_one, in_axes=(0, None)))
 
 
-@lru_cache(maxsize=128)
+@lru_cache(maxsize=16)
 def _tof_program(spec: ModelSpec):
     def tof_one(cond, y, mask):
         return engine.tof(spec, cond, y, mask)
@@ -143,17 +156,62 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
     return ys[:n], ok[:n]
 
 
+@lru_cache(maxsize=16)
+def _jacobian_program(spec: ModelSpec):
+    dyn = jnp.asarray(spec.dynamic_indices)
+
+    def jac_one(cond, y):
+        return engine.steady_jacobian(spec, cond, y[dyn])
+
+    return jax.jit(jax.vmap(jac_one))
+
+
+def stability_mask(spec: ModelSpec, conds: Conditions, ys,
+                   pos_tol: float = 1e-2, ok=None) -> np.ndarray:
+    """[lanes] Jacobian-eigenvalue stability verdict (reference
+    solver.py:102-106) for batched steady solutions: the dynamic-block
+    Jacobians are built in one vmapped device program; the nonsymmetric
+    eigenvalue solve (host-only in XLA) runs batched in numpy.
+
+    ``ok``: optional [lanes] convergence mask -- non-converged or
+    non-finite lanes are reported unstable without entering the
+    eigenvalue solve (numpy eig raises on non-finite input, and failed
+    lanes may hold divergent iterates)."""
+    from ..solvers.newton import stability_tolerance
+    Js = np.asarray(_jacobian_program(spec)(conds, jnp.asarray(ys)))
+    good = np.isfinite(Js).all(axis=(-2, -1))
+    if ok is not None:
+        good &= np.asarray(ok).astype(bool)
+    out = np.zeros(Js.shape[0], dtype=bool)
+    if good.any():
+        eig = np.linalg.eigvals(Js[good])
+        tol = stability_tolerance(Js[good], pos_tol)
+        out[good] = np.all(eig.real <= tol[..., None], axis=-1)
+    return out
+
+
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
                        x0=None, opts: SolverOptions = SolverOptions(),
-                       mesh: Optional[Mesh] = None):
+                       mesh: Optional[Mesh] = None,
+                       check_stability: bool = False,
+                       pos_jac_tol: float = 1e-2):
     """Steady state + optional TOF for every lane; the one-call volcano.
 
     Returns dict with y [lanes, n_s], success [lanes], residual [lanes],
-    and (if tof_mask given) tof [lanes] and activity [lanes].
+    and (if tof_mask given) tof [lanes] and activity [lanes]. With
+    check_stability, converged-but-unstable lanes (Jacobian eigenvalue
+    verdict) are demoted to success=False and reported under 'stable' --
+    grid triage then treats them like any other failed lane.
     """
     res = batch_steady_state(spec, conds, x0=x0, opts=opts, mesh=mesh)
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts}
+    if check_stability:
+        stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
+                                ok=np.asarray(res.success))
+        out["stable"] = stable
+        out["success"] = jnp.logical_and(jnp.asarray(res.success),
+                                         jnp.asarray(stable))
     if tof_mask is not None:
         tofs = _tof_program(spec)(conds, res.x, jnp.asarray(tof_mask))
         out["tof"] = tofs

@@ -48,7 +48,12 @@ def save_state_vibrations(state, path: str):
             k += 1
 
 
-def _state_cfg(st) -> dict:
+def _state_cfg(st, sname=None) -> dict:
+    """Serialize one state. ``sname`` maps gasdata partner State ->
+    checkpoint name (needed for inlined donor states that were renamed
+    on collision)."""
+    if sname is None:
+        sname = lambda s: s.name  # noqa: E731
     st.load()
     cfg = {"state_type": st.state_type}
     if st.sigma is not None:
@@ -72,7 +77,7 @@ def _state_cfg(st) -> dict:
     if st.gasdata is not None:
         cfg["gasdata"] = {
             "fraction": list(st.gasdata["fraction"]),
-            "state": [s.name if hasattr(s, "name") else s
+            "state": [sname(s) if hasattr(s, "name") else s
                       for s in st.gasdata["state"]],
         }
     if isinstance(st, ScalingState):
@@ -91,18 +96,25 @@ def _state_cfg(st) -> dict:
     return cfg
 
 
-def _reaction_cfg(rx) -> dict:
+def _reaction_cfg(rx, sname=None, base_names=None) -> dict:
+    """Serialize one reaction. ``sname`` maps State -> checkpoint name
+    (defaults to the state's own name); ``base_names`` maps id(base
+    reaction) -> checkpoint name for foreign donor bases."""
+    if sname is None:
+        sname = lambda s: s.name  # noqa: E731
     cfg = {"reac_type": rx.reac_type,
            "area": rx.area,
-           "reactants": [s.name for s in rx.reactants],
-           "products": [s.name for s in rx.products],
-           "TS": [s.name for s in rx.TS] if rx.TS else None}
+           "reactants": [sname(s) for s in rx.reactants],
+           "products": [sname(s) for s in rx.products],
+           "TS": [sname(s) for s in rx.TS] if rx.TS else None}
     if not rx.reversible:
         cfg["reversible"] = False
     if rx.scaling != 1.0:
         cfg["scaling"] = rx.scaling
     if isinstance(rx, ReactionDerivedReaction):
-        cfg["base_reaction"] = rx.base_reaction.name
+        base = rx.base_reaction
+        cfg["base_reaction"] = ((base_names or {}).get(id(base))
+                                or base.name)
     if isinstance(rx, UserDefinedReaction):
         for key in ("dErxn_user", "dGrxn_user", "dEa_fwd_user",
                     "dGa_fwd_user", "dEa_rev_user", "dGa_rev_user"):
@@ -112,18 +124,81 @@ def _reaction_cfg(rx) -> dict:
     return cfg
 
 
+def _collect_foreign_bases(sim):
+    """Foreign donor base reactions/states of ReactionDerivedReactions
+    (Butadiene-style MKM: bases live in a donor DFT system). Returns
+    (base_states {ckpt name -> State}, base_rx {ckpt name -> Reaction},
+    sname mapper, base_names {id(rx) -> ckpt name}) so the checkpoint can
+    inline the donor energetics and reload WITHOUT re-supplying
+    base_system."""
+    base_states, base_rx = {}, {}
+    state_names, base_names = {}, {}
+    taken_states = set(sim.states)
+    taken_rx = set(sim.reactions)
+
+    def fresh(name, taken, extra):
+        out, k = name, 1
+        while out in taken or out in extra:
+            out = f"{name}@base{k}"
+            k += 1
+        return out
+
+    # Transitive worklist: a donor base may itself be derived from yet
+    # another donor reaction.
+    work = [rx.base_reaction for rx in sim.reactions.values()
+            if isinstance(rx, ReactionDerivedReaction)]
+    while work:
+        b = work.pop()
+        if sim.reactions.get(b.name) is b or id(b) in base_names:
+            continue
+        bname = fresh(b.name, taken_rx, base_rx)
+        base_names[id(b)] = bname
+        base_rx[bname] = b
+        if isinstance(b, ReactionDerivedReaction):
+            work.append(b.base_reaction)
+        for s in list(b.reactants) + list(b.products) + list(b.TS or []):
+            if id(s) in state_names or sim.states.get(s.name) is s:
+                continue
+            if s.is_scaling:
+                raise NotImplementedError(
+                    f"donor base state {s.name} is a ScalingState; "
+                    "scaling relations must resolve within one system "
+                    "(build_spec enforces the same)")
+            nm = fresh(s.name, taken_states, base_states)
+            state_names[id(s)] = nm
+            base_states[nm] = s
+            # Inline gasdata partners of donor states too, so the
+            # checkpoint's gas-mixture corrections resolve on reload.
+            for g in (s.gasdata or {}).get("state", []):
+                if (hasattr(g, "name") and id(g) not in state_names
+                        and sim.states.get(g.name) is not g):
+                    gn = fresh(g.name, taken_states, base_states)
+                    state_names[id(g)] = gn
+                    base_states[gn] = g
+
+    def sname(s):
+        return state_names.get(id(s), s.name)
+
+    return base_states, base_rx, sname, base_names
+
+
 def system_to_dict(sim) -> dict:
     """Serialize a System into the reference input-file schema with all
-    resolved data inlined -- the pickle-replacement checkpoint."""
+    resolved data inlined -- the pickle-replacement checkpoint. Foreign
+    donor base reactions (and their states) are inlined under the
+    'base reactions' / 'base states' extension sections, which the loader
+    reads back as energy-only donors."""
     p = sim.params["pressure"]
     states, scaling = {}, {}
     for name, st in sim.states.items():
         (scaling if isinstance(st, ScalingState) else states)[name] = \
             _state_cfg(st)
 
+    base_states, base_rx, sname, base_names = _collect_foreign_bases(sim)
+
     plain, manual, derived = {}, {}, {}
     for name, rx in sim.reactions.items():
-        cfg = _reaction_cfg(rx)
+        cfg = _reaction_cfg(rx, base_names=base_names)
         if isinstance(rx, ReactionDerivedReaction):
             derived[name] = cfg
         elif isinstance(rx, UserDefinedReaction):
@@ -154,15 +229,25 @@ def system_to_dict(sim) -> dict:
     }
     if sim.params.get("inflow_state"):
         sys_cfg["inflow_state"] = _unscale_gas(sim.params["inflow_state"])
+    if getattr(sim, "desorption_model", "detailed_balance") != \
+            "detailed_balance":
+        sys_cfg["desorption_model"] = sim.desorption_model
 
     cfg = {"states": states}
     if scaling:
         cfg["scaling relation states"] = scaling
+    if base_states:
+        cfg["base states"] = {n: _state_cfg(s, sname=sname)
+                              for n, s in base_states.items()}
     cfg["system"] = sys_cfg
     if plain:
         cfg["reactions"] = plain
     if manual:
         cfg["manual reactions"] = manual
+    if base_rx:
+        cfg["base reactions"] = {
+            n: _reaction_cfg(r, sname=sname, base_names=base_names)
+            for n, r in base_rx.items()}
     if derived:
         cfg["reaction derived reactions"] = derived
     if sim.reactor is not None:

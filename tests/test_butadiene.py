@@ -87,6 +87,25 @@ def test_mkm_star_naming_single_site_group(mkm_system):
     assert int(g.sum()) == len(spec.adsorbate_indices)
 
 
+def test_mkm_checkpoint_roundtrip(mkm_system, tmp_path):
+    """Checkpoint of a derived-reaction system inlines the donor base
+    reactions/states ('base reactions'/'base states' sections), so it
+    reloads WITHOUT re-supplying base_system and reproduces the same
+    rate constants."""
+    from pycatkin_tpu.utils import save_system_json
+    path = str(tmp_path / "mkm_ckpt.json")
+    save_system_json(mkm_system, path)
+    sim2 = pk.read_from_input_file(path)  # no base_system
+    assert set(sim2.reactions) == set(mkm_system.reactions)
+    kf1, kr1, _ = mkm_system.rate_constant_table()
+    kf2, kr2, _ = sim2.rate_constant_table()
+    r1 = list(mkm_system.spec.rnames)
+    r2 = list(sim2.spec.rnames)
+    order = [r2.index(n) for n in r1]
+    np.testing.assert_allclose(kf2[order], kf1, rtol=1e-8)
+    np.testing.assert_allclose(kr2[order], kr1, rtol=1e-8)
+
+
 def test_mkm_steady_state(mkm_system):
     res = mkm_system.find_steady(use_transient_guess=False)
     assert bool(res.success)

@@ -50,6 +50,44 @@ def test_system_json_roundtrip_dmtm(ref_root, tmp_path):
                                np.asarray(fe2.gfree)[i2], atol=1e-10)
 
 
+def test_roundtrip_user_defined_donor_base(tmp_path):
+    """A derived reaction whose donor base is a UserDefinedReaction
+    round-trips: the checkpoint inlines the donor under 'base reactions'
+    and the loader reconstitutes it with its user energies."""
+    from pycatkin_tpu.api.system import System
+    from pycatkin_tpu.frontend.reactions import (ReactionDerivedReaction,
+                                                 UserDefinedReaction)
+    from pycatkin_tpu.frontend.states import State
+    from pycatkin_tpu.models.reactor import InfiniteDilutionReactor
+
+    # Donor lives outside the system (foreign states + user energies).
+    d_s = State(name="ds", state_type="surface")
+    d_sa = State(name="dsa", state_type="adsorbate")
+    base = UserDefinedReaction(name="b1", reac_type="arrhenius",
+                               reactants=[d_s], products=[d_sa],
+                               dGrxn_user=-0.4, dGa_fwd_user=0.7)
+    s = State(name="s", state_type="surface")
+    sa = State(name="sa", state_type="adsorbate")
+    rx = ReactionDerivedReaction(name="r1", reac_type="arrhenius",
+                                 reactants=[s], products=[sa],
+                                 base_reaction=base)
+    sim = System(start_state={"s": 1.0}, T=500.0, p=1.0e5)
+    sim.add_state(s)
+    sim.add_state(sa)
+    sim.add_reaction(rx)
+    sim.add_reactor(InfiniteDilutionReactor())
+    kf1, kr1, _ = sim.rate_constant_table()
+
+    path = str(tmp_path / "udr_base_ckpt.json")
+    save_system_json(sim, path)
+    sim2 = pk.read_from_input_file(path)
+    assert isinstance(sim2.reactions["r1"].base_reaction,
+                      UserDefinedReaction)
+    kf2, kr2, _ = sim2.rate_constant_table()
+    np.testing.assert_allclose(kf2, kf1, rtol=1e-10)
+    np.testing.assert_allclose(kr2, kr1, rtol=1e-10)
+
+
 def test_state_dat_roundtrip(volcano, tmp_path):
     from pycatkin_tpu.frontend import parsers
     from pycatkin_tpu.frontend.states import State

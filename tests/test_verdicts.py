@@ -1,0 +1,344 @@
+"""Solver verdicts and rate-model dispatch added in round 2.
+
+Covers: the Jacobian-eigenvalue stability verdict (reference
+solver.py:102-106) rejecting converged-but-unstable fixed points; the
+collision/statistical desorption model (reference reaction.py:134-162 +
+rate_constants.py:26-53) exposed through System/loader config; per-T
+user-energy dict interpolation; and the multi-surface leftover-adsorbate
+conservation-group warning.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.api.system import System
+from pycatkin_tpu.constants import R, eVtokJ, h, kB
+from pycatkin_tpu.frontend.reactions import Reaction, UserDefinedReaction
+from pycatkin_tpu.frontend.states import State
+from pycatkin_tpu.models.reactor import InfiniteDilutionReactor
+
+eVtoJmol = eVtokJ * 1.0e3
+
+
+def _ga_for_rate(k, T):
+    """Forward free-energy barrier [eV] giving TST rate constant k at T."""
+    return -R * T * np.log(k * h / (kB * T)) / eVtoJmol
+
+
+# ---------------------------------------------------------------------
+# Stability verdict
+@pytest.fixture(scope="module")
+def bistable():
+    """Autocatalytic surface mechanism with three fixed points.
+
+    r1: s + 2 sa -> 3 sa (rate k1*s*a^2), r2: sa -> s (rate k2*a);
+    da/dt = a*(k1*a*(1-a) - k2). With k1=10, k2=1: a=0 (stable),
+    a=(10-sqrt(60))/20 ~ 0.1127 (UNSTABLE), a ~ 0.8873 (stable).
+    """
+    T = 500.0
+    s = State(name="s", state_type="surface")
+    sa = State(name="sa", state_type="adsorbate")
+    r1 = UserDefinedReaction(name="r1", reac_type="arrhenius",
+                             reversible=False,
+                             reactants=[s, sa, sa], products=[sa, sa, sa],
+                             dGrxn_user=0.0,
+                             dGa_fwd_user=_ga_for_rate(10.0, T))
+    r2 = UserDefinedReaction(name="r2", reac_type="arrhenius",
+                             reversible=False,
+                             reactants=[sa], products=[s],
+                             dGrxn_user=0.0,
+                             dGa_fwd_user=_ga_for_rate(1.0, T))
+    sim = System(start_state={"s": 1.0}, T=T, p=1.0e5)
+    for st in (s, sa):
+        sim.add_state(st)
+    sim.add_reaction(r1)
+    sim.add_reaction(r2)
+    sim.add_reactor(InfiniteDilutionReactor())
+    sim.build()
+    return sim
+
+
+A_UNSTABLE = (10.0 - np.sqrt(60.0)) / 20.0
+A_STABLE = (10.0 + np.sqrt(60.0)) / 20.0
+
+
+def _full_y(sim, a):
+    y = np.zeros(sim.spec.n_species)
+    y[sim.spec.sindex("s")] = 1.0 - a
+    y[sim.spec.sindex("sa")] = a
+    return y
+
+
+def test_rate_constants_hit_targets(bistable):
+    kf, kr, _ = bistable.rate_constant_table()
+    np.testing.assert_allclose(kf, [10.0, 1.0], rtol=1e-10)
+    np.testing.assert_allclose(kr, 0.0)
+
+
+def test_check_stability_classifies_roots(bistable):
+    cond = bistable.conditions()
+    spec = bistable.spec
+    assert not engine.check_stability(spec, cond, _full_y(bistable,
+                                                          A_UNSTABLE))
+    assert engine.check_stability(spec, cond, _full_y(bistable, A_STABLE))
+    assert engine.check_stability(spec, cond, _full_y(bistable, 0.0))
+
+
+def test_solver_accepts_unstable_root_without_verdict(bistable):
+    """Documents the trap: started ON the unstable root, the PTC residual
+    is zero and the plain convergence tests pass (reference system.py
+    before the fork's solver.py verdict)."""
+    res = bistable.find_steady(y0=_full_y(bistable, A_UNSTABLE),
+                               use_transient_guess=False,
+                               check_stability=False)
+    assert bool(res.success)
+    a = float(np.asarray(res.x)[bistable.spec.sindex("sa")])
+    assert a == pytest.approx(A_UNSTABLE, abs=1e-6)
+
+
+def test_stability_verdict_rejects_and_escapes(bistable):
+    """With the verdict on (default), the unstable root is rejected and
+    the retry lands on a STABLE fixed point (reference solver.py:102-106
+    semantics)."""
+    res = bistable.find_steady(y0=_full_y(bistable, A_UNSTABLE),
+                               use_transient_guess=False)
+    a = float(np.asarray(res.x)[bistable.spec.sindex("sa")])
+    if bool(res.success):
+        assert engine.check_stability(bistable.spec, bistable.conditions(),
+                                      np.asarray(res.x))
+        assert abs(a - A_UNSTABLE) > 1e-3
+    else:
+        pytest.fail("verdict retry should find one of the stable roots")
+
+
+def test_batched_stability_mask(bistable):
+    from pycatkin_tpu.parallel.batch import stability_mask, stack_conditions
+    conds = stack_conditions([bistable.conditions()] * 3)
+    ys = np.stack([_full_y(bistable, A_UNSTABLE),
+                   _full_y(bistable, A_STABLE),
+                   _full_y(bistable, 0.0)])
+    mask = stability_mask(bistable.spec, conds, ys)
+    np.testing.assert_array_equal(mask, [False, True, True])
+
+
+# ---------------------------------------------------------------------
+# Collision desorption model
+def _kdes_reference(T, mass, area, sigma, inertia, des_en):
+    """Independent host implementation of the reference formula
+    (rate_constants.py:26-53), straight from the docstring math."""
+    from pycatkin_tpu.constants import amuA2tokgm2, amutokg
+    inertia = list(inertia)
+    if len(inertia) == 3 and all(abs(k) > 0.001 for k in inertia):
+        theta = [h ** 2 / (8 * np.pi ** 2 * (I * amuA2tokgm2) * kB)
+                 for I in inertia]
+        coeff = (kB ** 2 * T ** 3.5 * area * 2 * np.pi ** 1.5 *
+                 (mass * amutokg)) / (h ** 3 * sigma * np.prod(theta))
+    else:
+        theta = h ** 2 / (8 * np.pi ** 2 *
+                          (max(inertia) * amuA2tokgm2) * kB)
+        coeff = (kB ** 2 * T ** 3 * area * 2 * np.pi *
+                 (mass * amutokg)) / (h ** 3 * sigma * theta)
+    return coeff * np.exp(-des_en / (R * T))
+
+
+def test_kdes_kernel_matches_reference_formula():
+    from pycatkin_tpu.ops import rates
+    # Polyatomic: 3 nonzero moments, T^3.5 law.
+    args = dict(T=600.0, mass=16.04, area=1.0e-19, sigma=12.0,
+                des_en=9.0e4)
+    poly = np.array([3.1, 3.1, 3.1])
+    got = float(rates.k_desorption(args["T"], args["mass"], args["area"],
+                                   args["sigma"], poly, 1.0,
+                                   args["des_en"]))
+    want = _kdes_reference(inertia=poly, **args)
+    assert got == pytest.approx(want, rel=1e-10)
+    # Linear: one zero moment, T^3 law on the largest moment.
+    lin = np.array([0.0, 8.9, 8.9])
+    got = float(rates.k_desorption(args["T"], args["mass"], args["area"],
+                                   args["sigma"], lin, 0.0,
+                                   args["des_en"]))
+    want = _kdes_reference(inertia=lin, **args)
+    assert got == pytest.approx(want, rel=1e-10)
+
+
+def _toy_ads_system(desorption_model, reac_type="adsorption"):
+    co = State(name="co", state_type="gas", mass=28.01, sigma=1.0,
+               inertia=[0.0, 8.9, 8.9], Gelec=0.0)
+    s = State(name="s", state_type="surface", Gelec=0.0)
+    sco = State(name="sco", state_type="adsorbate", Gelec=-1.0)
+    if reac_type == "adsorption":
+        rx = Reaction(name="ads", reac_type="adsorption",
+                      reactants=[co, s], products=[sco], area=1.0e-19)
+    else:
+        rx = Reaction(name="des", reac_type="desorption",
+                      reactants=[sco], products=[co, s], area=1.0e-19)
+    sim = System(start_state={"s": 1.0, "co": 1.0}, T=500.0, p=1.0e5,
+                 desorption_model=desorption_model)
+    for st in (co, s, sco):
+        sim.add_state(st)
+    sim.add_reaction(rx)
+    sim.add_reactor(InfiniteDilutionReactor())
+    return sim.build()
+
+
+def test_collision_model_changes_reverse_rate():
+    from pycatkin_tpu.ops import rates
+    db = _toy_ads_system("detailed_balance")
+    col = _toy_ads_system("collision")
+    assert db.spec.desorption_model == "detailed_balance"
+    assert col.spec.desorption_model == "collision"
+    kf_db, kr_db, keq_db = db.rate_constant_table()
+    kf_col, kr_col, _ = col.rate_constant_table()
+    # Forward sticking rate identical under both conventions.
+    np.testing.assert_allclose(kf_db, kf_col, rtol=1e-12)
+    # Detailed balance: kr = kads / Keq.
+    np.testing.assert_allclose(kr_db, kf_db / keq_db, rtol=1e-12)
+    # Collision: kr = kdes with des_en = -dErxn (reference
+    # reaction.py:141-147); dErxn here is -1 eV.
+    re = col.reaction_energy_table()
+    want = _kdes_reference(T=500.0, mass=28.01, area=1.0e-19, sigma=1.0,
+                           inertia=[0.0, 8.9, 8.9],
+                           des_en=-float(np.asarray(re.dErxn)[0]))
+    assert float(kr_col[0]) == pytest.approx(want, rel=1e-8)
+    assert not np.allclose(kr_db, kr_col)
+
+
+def test_collision_model_desorption_type():
+    from pycatkin_tpu.ops import rates
+    db = _toy_ads_system("detailed_balance", reac_type="desorption")
+    col = _toy_ads_system("collision", reac_type="desorption")
+    kf_db, kr_db, keq = db.rate_constant_table()
+    kf_col, kr_col, _ = col.rate_constant_table()
+    # Reverse (adsorption) identical; forward differs by model.
+    np.testing.assert_allclose(kr_db, kr_col, rtol=1e-12)
+    np.testing.assert_allclose(kf_db, kr_db * keq, rtol=1e-12)
+    re = col.reaction_energy_table()
+    want = _kdes_reference(T=500.0, mass=28.01, area=1.0e-19, sigma=1.0,
+                           inertia=[0.0, 8.9, 8.9],
+                           des_en=float(np.asarray(re.dErxn)[0]))
+    assert float(kf_col[0]) == pytest.approx(want, rel=1e-8)
+
+
+def test_collision_model_end_to_end_solves():
+    for model in ("detailed_balance", "collision"):
+        sim = _toy_ads_system(model)
+        res = sim.find_steady(use_transient_guess=False)
+        assert bool(res.success), model
+        th = float(np.asarray(res.x)[sim.spec.sindex("sco")])
+        assert 0.0 <= th <= 1.0
+    # The two conventions give different equilibrium coverages here.
+    th_db = _toy_ads_system("detailed_balance").find_steady(
+        use_transient_guess=False)
+    th_col = _toy_ads_system("collision").find_steady(
+        use_transient_guess=False)
+    i = _toy_ads_system("collision").spec.sindex("sco")
+    assert abs(float(np.asarray(th_db.x)[i]) -
+               float(np.asarray(th_col.x)[i])) > 1e-6
+
+
+def test_desorption_model_from_json(tmp_path):
+    cfg = {
+        "states": {
+            "co": {"state_type": "gas", "mass": 28.01, "sigma": 1.0,
+                   "inertia": [0.0, 8.9, 8.9], "Gelec": 0.0},
+            "s": {"state_type": "surface", "Gelec": 0.0},
+            "sco": {"state_type": "adsorbate", "Gelec": -1.0},
+        },
+        "system": {"T": 500.0, "p": 1.0e5,
+                   "start_state": {"s": 1.0, "co": 1.0},
+                   "desorption_model": "collision"},
+        "reactions": {
+            "ads": {"reac_type": "adsorption", "area": 1.0e-19,
+                    "reactants": ["co", "s"], "products": ["sco"]},
+        },
+        "reactor": "InfiniteDilutionReactor",
+    }
+    path = tmp_path / "collision.json"
+    path.write_text(json.dumps(cfg))
+    sim = pk.read_from_input_file(str(path))
+    assert sim.desorption_model == "collision"
+    assert sim.spec.desorption_model == "collision"
+    # And it survives the checkpoint round-trip.
+    from pycatkin_tpu.utils import save_system_json
+    ck = tmp_path / "ckpt.json"
+    save_system_json(sim, str(ck))
+    sim2 = pk.read_from_input_file(str(ck))
+    assert sim2.desorption_model == "collision"
+
+
+def test_desorption_model_validated():
+    with pytest.raises(ValueError, match="desorption_model"):
+        System(desorption_model="nonsense")
+
+
+# ---------------------------------------------------------------------
+# Per-temperature user-energy dicts
+def test_user_energy_dict_interpolates():
+    from pycatkin_tpu.frontend.reactions import _resolve_user_value
+    table = {400.0: 1.0, 800: 2.0}
+    assert _resolve_user_value(table, 400.0) == 1.0
+    assert _resolve_user_value(table, 800.0) == 2.0
+    assert _resolve_user_value(table, 600.0) == pytest.approx(1.5)
+    assert _resolve_user_value(table, 500) == pytest.approx(1.25)
+    with pytest.raises(ValueError, match="cannot extrapolate"):
+        _resolve_user_value(table, 300.0)
+
+
+def test_user_energy_dict_in_sweep():
+    """A T-swept solve across a per-T dict no longer KeyErrors (the
+    reference sharp edge, reaction.py:228-260)."""
+    T = 500.0
+    s = State(name="s", state_type="surface")
+    sa = State(name="sa", state_type="adsorbate")
+    rx = UserDefinedReaction(name="r1", reac_type="arrhenius",
+                             reactants=[s], products=[sa],
+                             dGrxn_user={400.0: -0.5, 800.0: -0.1},
+                             dGa_fwd_user=0.5)
+    sim = System(start_state={"s": 1.0}, T=T, p=1.0e5)
+    sim.add_state(s)
+    sim.add_state(sa)
+    sim.add_reaction(rx)
+    sim.add_reactor(InfiniteDilutionReactor())
+    sim.build()
+    for T in (400.0, 600.0, 800.0):
+        sim.T = T
+        kf, kr, keq = sim.rate_constant_table()
+        assert np.all(np.isfinite(kf)) and np.all(np.isfinite(kr))
+    # Interpolated dGrxn at 600 K: -0.3 eV.
+    sim.T = 600.0
+    _, _, keq = sim.rate_constant_table()
+    assert float(keq[0]) == pytest.approx(
+        np.exp(0.3 * eVtoJmol / (R * 600.0)), rel=1e-10)
+
+
+# ---------------------------------------------------------------------
+# Multi-surface leftover adsorbates warn instead of silently merging
+def test_multi_surface_leftover_warns():
+    a = State(name="a", state_type="surface")
+    ax = State(name="ax", state_type="adsorbate")
+    b = State(name="b", state_type="surface")
+    zq = State(name="zq", state_type="adsorbate")
+    r1 = UserDefinedReaction(name="r1", reac_type="arrhenius",
+                             reactants=[ax], products=[a],
+                             dGrxn_user=0.0, dGa_fwd_user=0.5)
+    r2 = UserDefinedReaction(name="r2", reac_type="arrhenius",
+                             reactants=[zq], products=[b],
+                             dGrxn_user=0.0, dGa_fwd_user=0.5)
+    sim = System(start_state={"a": 0.5, "b": 0.5}, T=500.0, p=1.0e5)
+    for st in (a, ax, b, zq):
+        sim.add_state(st)
+    sim.add_reaction(r1)
+    sim.add_reaction(r2)
+    sim.add_reactor(InfiniteDilutionReactor())
+    with pytest.warns(UserWarning, match="zq"):
+        sim.build()
+    # Exactly one surface ('b') matched nothing, so zq is assumed to be
+    # its adsorbate -- but loudly, via the warning above.
+    spec = sim.spec
+    assert spec.groups.shape[0] == 2
+    gb = next(g for g in spec.groups if g[spec.sindex("b")] == 1.0)
+    assert gb[spec.sindex("zq")] == 1.0
