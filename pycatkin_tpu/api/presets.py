@@ -49,9 +49,11 @@ def _net_rates_program(spec):
 @lru_cache(maxsize=128)
 def _drc_program(spec, tof_terms, drc_mode, eps, sopts):
     if drc_mode == "fd":
+        # opts deliberately not forwarded: drc_fd's default tightened
+        # tolerances are required for a meaningful difference quotient.
         def drc_one(cond, x0):
             return engine.drc_fd(spec, cond, list(tof_terms), eps=eps,
-                                 x0=x0, opts=sopts)
+                                 x0=x0)
     else:
         def drc_one(cond, x0):
             return engine.drc(spec, cond, list(tof_terms), x0=x0,

@@ -351,8 +351,14 @@ class System:
             xi = engine.drc(self.spec, cond, tof_terms, x0=x0,
                             opts=self.solver_options())
         else:
-            xi = engine.drc_fd(self.spec, cond, tof_terms, eps=eps, x0=x0,
-                               opts=self.solver_options())
+            xi, ok = engine.drc_fd(self.spec, cond, tof_terms, eps=eps,
+                                   x0=x0, return_success=True)
+            if not bool(ok):
+                import warnings
+                warnings.warn(
+                    "finite-difference DRC: not all perturbed steady "
+                    "solves converged; values may be unreliable (prefer "
+                    "mode='implicit')", stacklevel=2)
         return dict(zip(self.spec.rnames, np.asarray(xi)))
 
     def activity(self, tof_terms, ss_solve=False):

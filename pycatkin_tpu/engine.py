@@ -312,7 +312,18 @@ def make_steady_x(spec: ModelSpec, opts: SolverOptions = SolverOptions(),
     def bwd(saved, xbar):
         x, cond = saved
         J = jax.jacfwd(_residual, argnums=0)(x, cond)
-        w = linalg.solve(J.T, xbar)
+        # Constrained IFT: x*(cond) satisfies the residual rows AND
+        # G x* = const, so one row per group (linearly dependent on its
+        # partners) is replaced by the constraint row, whose dF/dcond
+        # entry is zero -- dx*/dcond = -B^{-1} Z dF/dcond with B the
+        # row-replaced Jacobian and Z zeroing the replaced entries. The
+        # operators come from the solver's own helper so the adjoint and
+        # the Newton iteration stay in exact lockstep.
+        dyn = np.asarray(spec.dynamic_indices)
+        G = jnp.asarray(spec.groups[:, dyn])
+        R, M = newton.conservation_constraints(G)
+        B = jnp.where(M[:, None] > 0, R, J)
+        w = linalg.solve(B.T, xbar) * (1.0 - M)
         _, vjp_cond = jax.vjp(lambda c: _residual(x, c), cond)
         (cond_bar,) = vjp_cond(-w)
         return (cond_bar,)
@@ -344,10 +355,33 @@ def drc(spec: ModelSpec, cond: Conditions, tof_terms,
 
 
 def drc_fd(spec: ModelSpec, cond: Conditions, tof_terms, eps: float = 1e-3,
-           opts: SolverOptions = SolverOptions(), x0=None, key=None):
+           opts: SolverOptions | None = None, x0=None, key=None,
+           return_success: bool = False):
     """Finite-difference DRC for parity with the reference
     (old_system.py:490-515): central difference with kf,kr scaled by
-    (1 +/- eps), all 2*n_r+1 solves batched through ``vmap``."""
+    (1 +/- eps), all 2*n_r+1 solves batched through ``vmap``.
+
+    When ``opts`` is not given, the perturbed solves are tightened far
+    below the default steady tolerance: an O(eps) rate perturbation
+    shifts the residual by O(eps * flux), so a solve that already meets
+    the default tolerance at x0 would not move at all and the difference
+    quotient would collapse to frozen-coverage flux fractions. Explicit
+    ``opts`` are honored verbatim.
+
+    ``return_success``: also return the all-lanes convergence flag --
+    an unconverged perturbed solve may sit on a best-effort iterate
+    (possibly another branch of a multistable system), poisoning the
+    difference quotient.
+
+    KNOWN LIMIT: deep in the stiff regime (e.g. DMTM at 400 K) the
+    perturbed root shift can sit below the f64 residual cancellation
+    floor; no absolute-residual solve can resolve it, and FD degenerates
+    while :func:`drc` (implicit differentiation, the default) remains
+    exact -- the analog of the reference needing per-component relative
+    ODE tolerances for its FD DRC (old_system.py:490-515)."""
+    if opts is None:
+        opts = SolverOptions(rate_tol=1e-14, rate_tol_rel=1e-13,
+                             max_steps=400)
     mask = jnp.asarray(tof_mask_for(spec, tof_terms))
     n_r = spec.n_reactions
     base = jnp.asarray(cond.kscale)
@@ -360,8 +394,11 @@ def drc_fd(spec: ModelSpec, cond: Conditions, tof_terms, eps: float = 1e-3,
     def solve_tof(kscale):
         c = cond._replace(kscale=kscale)
         res = steady_state(spec, c, x0=x0, key=key, opts=opts)
-        return tof(spec, c, res.x, mask)
+        return tof(spec, c, res.x, mask), res.success
 
-    tofs = jax.vmap(solve_tof)(scales)
+    tofs, ok = jax.vmap(solve_tof)(scales)
     t0, tp, tm = tofs[0], tofs[1:1 + n_r], tofs[1 + n_r:]
-    return (tp - tm) / (2.0 * eps * t0)
+    xi = (tp - tm) / (2.0 * eps * t0)
+    if return_success:
+        return xi, jnp.all(ok)
+    return xi

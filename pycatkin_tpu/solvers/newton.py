@@ -85,6 +85,34 @@ def _rnorm(F, gross, opts: SolverOptions):
     return jnp.max(jnp.abs(F) / (opts.rate_tol + opts.rate_tol_rel * gross))
 
 
+def conservation_constraints(groups_dyn):
+    """Row-replacement operators for the conservation constraints.
+
+    Site conservation makes the dynamic Jacobian exactly singular at
+    every root (each group indicator is a left null vector; the
+    within-group rows of the residual are linearly dependent), so bare
+    Newton degenerates near solutions. The exact, stiffness-stable fix:
+    replace one row per nonempty group (its first member) with the
+    constraint row G_g and zero that residual entry -- no information is
+    lost (the replaced row equals minus the sum of its group partners)
+    and every step satisfies G dx = 0, i.e. Newton walks along the
+    conservation manifold through a nonsingular matrix.
+
+    Returns (R [n, n], M [n]): replacement row contents and a 0/1 mask of
+    rows to replace. Empty groups (e.g. a model with no adsorbates)
+    replace nothing. Apply as ``where(M[:, None] > 0, R, A)`` and
+    ``F * (1 - M)``; the IFT adjoint must use the SAME operators.
+    """
+    n = groups_dyn.shape[1]
+    have = jnp.sum(groups_dyn > 0, axis=1) > 0
+    con_rows = jnp.argmax(groups_dyn > 0, axis=1)
+    R = jnp.zeros((n, n), groups_dyn.dtype)
+    R = R.at[con_rows, :].add(jnp.where(have[:, None], groups_dyn, 0.0))
+    M = jnp.zeros((n,), groups_dyn.dtype)
+    M = M.at[con_rows].max(have.astype(groups_dyn.dtype))
+    return R, M
+
+
 def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     """One PTC run from x0; returns (x, normalized_residual, steps).
 
@@ -93,6 +121,7 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     each step costs one Jacobian and one fresh evaluation."""
     n = x0.shape[0]
     eye = jnp.eye(n, dtype=x0.dtype)
+    R, M = conservation_constraints(groups_dyn)
 
     def cond(state):
         x, F, dt, fnorm, k = state
@@ -101,8 +130,8 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     def body(state):
         x, F, dt, fnorm, k = state
         J = jac_fn(x)
-        A = eye / dt - J
-        dx = linalg.solve(A, F)
+        A = jnp.where(M[:, None] > 0, R, eye / dt - J)
+        dx = linalg.solve(A, F * (1.0 - M))
         # Projected PTC: clamp nonnegative AND renormalize conservation
         # groups (reference min_tol flooring + _normalize_y semantics,
         # system.py:305-328). Negative coverages flip rate signs and

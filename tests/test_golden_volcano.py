@@ -62,3 +62,17 @@ def test_volcano_point_steady_state_matches_transient(volcano_system):
     a_steady = volcano_system.activity(tof_terms=["CO_ox"], ss_solve=True)
     assert a_steady == pytest.approx(a_transient, abs=5e-3)
     assert bool(volcano_system.steady_result.success)
+
+
+def test_volcano_point_drc_implicit_vs_fd(volcano_system):
+    """Implicit-vs-FD DRC parity at the golden volcano point: every
+    reaction's xi agrees to <=1e-3 and the ID-reactor sum rule holds."""
+    set_descriptors(volcano_system, -1.0, -1.0)
+    volcano_system.solve_odes()
+    xi_imp = volcano_system.degree_of_rate_control(["CO_ox"],
+                                                   mode="implicit")
+    xi_fd = volcano_system.degree_of_rate_control(["CO_ox"], mode="fd",
+                                                  eps=1.0e-3)
+    for rname in xi_imp:
+        assert abs(xi_imp[rname] - xi_fd[rname]) <= 1e-3, rname
+    assert sum(xi_imp.values()) == pytest.approx(1.0, abs=1e-6)
