@@ -74,10 +74,15 @@ def scipy_baseline_seconds_per_point(sim, sample_points):
 
 
 def main():
+    from pycatkin_tpu.utils.cache import enable_persistent_cache
+    cache_dir = enable_persistent_cache()
+
     import jax
 
     from pycatkin_tpu import engine
     from pycatkin_tpu.parallel.batch import sweep_steady_state
+
+    log(f"persistent compilation cache: {cache_dir}")
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
@@ -142,6 +147,9 @@ def main():
         # null when no baseline could be measured (no fabricated ratio).
         "vs_baseline": (round(vs_baseline, 2) if vs_baseline is not None
                         else None),
+        # compile+first-run wall time; ~solve-time on a warm persistent
+        # cache, ~2 min on a cold one (the VERDICT round-1 finding).
+        "compile_s": round(compile_and_run, 2),
     }))
 
 

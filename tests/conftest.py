@@ -22,6 +22,10 @@ if os.environ.get("PYCATKIN_TEST_TPU", "0") != "1":
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+from pycatkin_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 import pytest  # noqa: E402
 
 REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
