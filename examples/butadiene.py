@@ -1,0 +1,119 @@
+"""Butadiene-from-ethanol MKM: pathway study over temperature.
+
+Port of /root/reference/examples/Butadiene/butadiene_mkm.py: a 118-state
+DFT landscape system donates energetics to a 34-species microkinetic
+model through ReactionDerivedReactions; pathway subsets are carved out
+by deleting reactions; each subset is swept 523-923 K reading the
+butadiene TOF from its three formation steps.
+
+The reference solves each (pathway, T) serially (butadiene_mkm.py:36-95);
+here each pathway's temperature sweep is one lane-batched device solve.
+Per reference, TOF is evaluated at the end of a transient solve (the
+steady solve is only checked); we use the batched steady solve directly,
+with the transient fallback inside the solver.
+
+Usage:  python examples/butadiene.py [output_dir] [n_temperatures]
+Artifacts: outputs/bd_tof_<case>.csv,
+figures/Butadiene_TOF_base_case_pathways.png (reference-named).
+"""
+
+import copy
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+
+REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
+
+# Pathway definitions (butadiene_mkm.py:15-23).
+ADSORPTION = ["9D-9C", "ethanol-1A", "8A-8C", "H2O-9B",
+              "acetaldehyde-10B", "crotonaldehyde-2N"]
+P123 = ["1A-1C", "2A-2C", "2F-2H", "2J-2L", "2L-2N", "3A-3C", "3D-3F",
+        "3F-3G"] + ADSORPTION
+P124 = ["1A-1C", "2A-2C", "2F-2H", "4A-4C", "4D-4Ca", "4D-4F", "4F-4H",
+        "4I-4K"] + ADSORPTION
+P156 = ["1A-1C", "5A-5C", "6A-6C", "6C-6E", "6E-6G", "6G-6H"] + ADSORPTION
+CASES = {
+    "p123_p124_p156": sorted(set(P123 + P124 + P156)),
+    "p123": P123,
+    "p124": P124,
+    "p156": P156,
+}
+# Butadiene formation steps whose net rates sum to the TOF
+# (butadiene_mkm.py:66-67).
+BD_TOF_TERMS = ["3F-3G", "4I-4K", "6G-6H"]
+
+
+def carve_pathway(mkm_system, pathways):
+    """Copy the MKM system and keep only the pathway's reactions
+    (butadiene_mkm.py:45-58)."""
+    sim = copy.deepcopy(mkm_system)
+    for rname in list(sim.reactions):
+        if rname not in pathways:
+            del sim.reactions[rname]
+    sim._spec = None  # structural change: recompile on next use
+    return sim
+
+
+def main(out_dir="examples/out/butadiene", n_T=9):
+    n_T = int(n_T)
+    fig_path = os.path.join(out_dir, "figures")
+    csv_path = os.path.join(out_dir, "outputs")
+    os.makedirs(fig_path, exist_ok=True)
+    os.makedirs(csv_path, exist_ok=True)
+
+    base = os.path.join(REFERENCE_ROOT, "examples", "Butadiene")
+    dft_system = pk.read_from_input_file(os.path.join(base, "input.json"))
+    mkm_system = pk.read_from_input_file(
+        os.path.join(base, "input_mkm.json"), base_system=dft_system)
+
+    Ts = np.linspace(start=523, stop=923, num=n_T, endpoint=True)
+    results = {}
+    for case, pathways in CASES.items():
+        sim = carve_pathway(mkm_system, pathways)
+        terms = [t for t in BD_TOF_TERMS if t in sim.reactions]
+        mask = engine.tof_mask_for(sim.spec, terms)
+        conds = broadcast_conditions(sim.conditions(), n_T)._replace(T=Ts)
+        out = sweep_steady_state(sim.spec, conds, tof_mask=mask)
+        tof = np.asarray(out["tof"])
+        n_ok = int(np.sum(np.asarray(out["success"])))
+        results[case] = tof
+        print(f"{case}: {len(sim.reactions)} reactions, "
+              f"{n_ok}/{n_T} lanes converged, "
+              f"TOF(max T) = {tof[-1]:.3e} 1/s")
+        np.savetxt(os.path.join(csv_path, f"bd_tof_{case}.csv"),
+                   np.column_stack([Ts, tof]), delimiter=",",
+                   header="T (K), butadiene TOF (1/s)")
+
+    # Reference-named pathway figure (butadiene_mkm.py:97-112).
+    fig, ax = plt.subplots(figsize=(3.2, 3.2))
+    colors = {"p123_p124_p156": "k", "p123": "purple",
+              "p124": "dodgerblue", "p156": "orange"}
+    for case, tof in results.items():
+        ax.plot(Ts, np.maximum(tof, 1e-300), label=case,
+                color=colors[case])
+    ax.set(xlabel="Temperature (K)", ylabel="TOF (1/s)",
+           xlim=(523, 923), ylim=(1e-12, 1e0), yscale="log")
+    ax.legend(fontsize=6)
+    fig.tight_layout()
+    fig.savefig(os.path.join(fig_path,
+                             "Butadiene_TOF_base_case_pathways.png"),
+                dpi=300)
+    plt.close(fig)
+    print(f"Butadiene artifacts written to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:3])

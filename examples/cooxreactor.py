@@ -1,0 +1,74 @@
+"""COOx CSTR reactor: CO conversion over AuPd and Pd111 catalysts.
+
+Port of /root/reference/examples/COOxReactor/cooxreactor.py: load both
+catalyst inputs (OUTCAR/log.vib DFT data, use_descriptor_as_reactant
+scaling states), sweep 20 temperatures with a steady-state solve (one
+batched program per system instead of the reference's serial loop),
+write pressure/coverage CSVs and the two-catalyst conversion figure.
+
+The reference also exports .pdb structure files via ASE
+(cooxreactor.py:18-25); structure I/O is out of scope here (no ASE),
+the kinetics workflow is complete.
+
+Usage:  python examples/cooxreactor.py [output_dir]
+Artifacts: outputs/{AuPd,Pd111}/*.csv, figures/conversion.png.
+"""
+
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.api.plotting import plot_data_simple
+from pycatkin_tpu.api.presets import run_temperatures
+
+REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
+
+
+def main(out_dir="examples/out/cooxreactor"):
+    fig_path = os.path.join(out_dir, "figures") + os.sep
+    os.makedirs(fig_path, exist_ok=True)
+
+    base = os.path.join(REFERENCE_ROOT, "examples", "COOxReactor")
+    sim_system_Au = pk.read_from_input_file(
+        os.path.join(base, "input_AuPd.json"))
+    sim_system_Pd = pk.read_from_input_file(
+        os.path.join(base, "input_Pd111.json"))
+
+    temperatures = np.linspace(start=423, stop=623, num=20, endpoint=True)
+    fig, ax = None, None
+    for sysname, sim_system in [["AuPd", sim_system_Au],
+                                ["Pd111", sim_system_Pd]]:
+        csv_path = os.path.join(out_dir, "outputs", sysname) + os.sep
+        run_temperatures(sim_system=sim_system, temperatures=temperatures,
+                         steady_state_solve=True, plot_results=False,
+                         save_results=True, csv_path=csv_path)
+
+        df = pd.read_csv(os.path.join(csv_path,
+                                      "pressures_vs_temperature.csv"))
+        pCOin = sim_system.params["inflow_state"]["CO"]
+        pCOout = df["pCO (bar)"].values
+        xCO = 100.0 * (1.0 - pCOout / pCOin)
+        print(f"{sysname}: conversion {xCO.min():.2f}..{xCO.max():.2f} % "
+              f"over {temperatures[0]:.0f}..{temperatures[-1]:.0f} K")
+
+        fig, ax = plot_data_simple(
+            fig=fig, ax=ax, xdata=temperatures, ydata=xCO,
+            xlabel="Temperature (K)", ylabel="Conversion (%)",
+            label=sysname, addlegend=True,
+            color="teal" if sysname == "Pd111" else "salmon",
+            fig_path=fig_path, fig_name="conversion")
+
+    print(f"COOxReactor artifacts written to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

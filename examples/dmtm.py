@@ -1,0 +1,88 @@
+"""DMTM (direct methane-to-methanol over Cu zeolites) workflow.
+
+Port of the reference's user-facing DMTM study
+(/root/reference/examples/DMTM/dmtm.py): energy landscapes, transient MK
+run, temperature sweep with steady-state solve and DRC, energy-span
+sweep, and the state/reaction energy CSV exports. Sweeps run as one
+batched device program instead of the reference's per-temperature Python
+loop (presets.py:31-167), so the 17-point sweep costs one compile + one
+batched solve.
+
+Usage:  python examples/dmtm.py [output_dir]
+Artifacts (reference-named, presets.py:133-167,378-499):
+  figures/: landscape pngs, transient/steady/rates/drc sweeps
+  outputs/: coverages/rates/drcs/energy-span/energies CSVs
+"""
+
+import copy
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.api.plotting import (compare_energy_landscapes,
+                                       draw_energy_landscapes)
+from pycatkin_tpu.api.presets import (run, run_energy_span_temperatures,
+                                      run_temperatures, save_energies,
+                                      save_energies_temperatures,
+                                      save_state_energies)
+
+REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
+
+
+def main(out_dir="examples/out/dmtm"):
+    fig_path = os.path.join(out_dir, "figures") + os.sep
+    csv_path = os.path.join(out_dir, "outputs") + os.sep
+
+    sim_system = pk.read_from_input_file(
+        os.path.join(REFERENCE_ROOT, "examples", "DMTM", "input.json"))
+
+    # Energy landscapes: electronic, then free energy at 450 K, then a
+    # two-temperature comparison (dmtm.py:11-31).
+    draw_energy_landscapes(sim_system=sim_system, etype="electronic",
+                           show_labels=True, fig_path=fig_path)
+    sim_system.params["temperature"] = 450
+    draw_energy_landscapes(sim_system=sim_system, fig_path=fig_path)
+
+    sim_system2 = copy.deepcopy(sim_system)
+    sim_system2.params["temperature"] = 650
+    compare_energy_landscapes(sim_systems={"450 K": sim_system,
+                                           "650 K": sim_system2},
+                              legend_location="upper right",
+                              show_labels=True, fig_path=fig_path)
+
+    # Transient microkinetics at 450 K (dmtm.py:33-38).
+    run(sim_system=sim_system, plot_results=True, save_results=True,
+        fig_path=fig_path, csv_path=csv_path)
+
+    # Temperature sweep with steady solve + DRC as one batched program
+    # (dmtm.py:40-59).
+    temperatures = np.linspace(start=400, stop=800, num=17, endpoint=True)
+    run_temperatures(sim_system=sim_system, temperatures=temperatures,
+                     tof_terms=["r5", "r9"], steady_state_solve=True,
+                     plot_results=True, save_results=True,
+                     fig_path=fig_path, csv_path=csv_path)
+
+    # Energy span model over the sweep (dmtm.py:61-65).
+    run_energy_span_temperatures(sim_system=sim_system,
+                                 temperatures=temperatures,
+                                 save_results=True, csv_path=csv_path)
+
+    # Energy tables (dmtm.py:67-77).
+    save_state_energies(sim_system=sim_system, csv_path=csv_path)
+    save_energies(sim_system=sim_system, csv_path=csv_path)
+    save_energies_temperatures(sim_system=sim_system,
+                               temperatures=temperatures, csv_path=csv_path)
+
+    print(f"DMTM artifacts written to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

@@ -1,0 +1,141 @@
+"""DMTM metals: 1-D *O-binding-energy volcano, 3 temperatures, dry/wet.
+
+Port of the reference production study
+/root/reference/examples/DMTM/metals/dmtm_metals_sr.py (plotcase
+'volcano', :56-108): scaling-relation inputs, gas-entropy energy
+modifiers on every minimum, then a sweep of the sO descriptor energy
+(sO.Gelec and the rsO manual reaction energy) with a steady-state solve
+per point, reading the TOF as the net rate of r5_rdr + r9_rdr.
+
+The reference solves 50 points x 3 T x {dry, wet} = 300 independent
+steady states in a serial Python loop; here each (study, T) slice is one
+lane-batched device solve over the descriptor axis.
+
+Usage:  python examples/dmtm_metals.py [output_dir] [n_points]
+Artifacts: outputs/tof_<study>.csv, figures/volcano_<study>.png.
+"""
+
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.parallel.batch import stack_conditions, sweep_steady_state
+
+REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
+
+# Landscape minima and the gas molecules adsorbed/released along the
+# path whose translational+rotational(+vibrational) entropy corrects
+# each minimum (dmtm_metals_sr.py:24-53).
+MINIMA = [
+    ["2s", "o2", "ch4", "ch4"],
+    ["2sO2s", "ch4", "ch4"],
+    ["sOs", "ch4", "ch4"],
+    ["sOsO", "ch4", "ch4"],
+    ["sOOs", "ch4", "ch4"],
+    ["s2Och4", "ch4"],
+    ["rad1", "ch4"],
+    ["sOsCH3OH", "ch4"],
+    ["sO", "ch4", "ch3oh"],
+    ["sOch4", "ch3oh"],
+    ["rad2", "ch3oh"],
+    ["sOHsCH3", "ch3oh"],
+    ["ts5", "ch3oh"],
+    ["sCH3OH", "ch3oh"],
+    ["s", "ch3oh", "ch3oh"],
+    ["ts6", "ch3oh", "ch3oh"],
+    ["s-pair.1", "ch3oh", "ch3oh"],
+]
+
+
+def apply_gas_entropy_modifiers(sys_, T, p):
+    """Reference dmtm_metals_sr.py:76-88: subtract the entropy of gases
+    consumed relative to the first minimum; partially restore CH4's
+    vibrational part for the physisorbed sOch4-type minima."""
+    sys_.free_energy_table(T=T, p=p)
+    gas_entropies = {}
+    for gas in ["o2_mk", "ch4_mk", "ch3oh_mk"]:
+        st = sys_.states[gas]
+        gas_entropies[gas] = (st.Gtran_computed + st.Grota_computed
+                              + st.Gvibr_computed)
+    for m in MINIMA:
+        if m[0] not in sys_.states:
+            continue
+        modifier = sum(gas_entropies[g + "_mk"] for g in m[1:])
+        modifier -= sum(gas_entropies[g + "_mk"] for g in MINIMA[0][1:])
+        if "Och4" in m[0]:
+            modifier += ((gas_entropies["ch4_mk"]
+                          - sys_.states["ch4_mk"].Gvibr_computed) * 0.67)
+        sys_.states[m[0]].set_energy_modifier(modifier=modifier)
+
+
+def volcano_slice(sys_, bsOs):
+    """One (study, T) slice: stack per-descriptor Conditions and solve
+    all lanes at once. TOF = net rate of r5_rdr + r9_rdr
+    (dmtm_metals_sr.py:102-108)."""
+    conds = []
+    for bsO in bsOs:
+        sys_.states["sO"].Gelec = float(bsO)
+        sys_.reactions["rsO"].dErxn_user = float(bsO)
+        conds.append(sys_.conditions())
+    batched = stack_conditions(conds)
+    mask = engine.tof_mask_for(sys_.spec, ["r5_rdr", "r9_rdr"])
+    out = sweep_steady_state(sys_.spec, batched, tof_mask=mask)
+    return np.asarray(out["tof"]), np.asarray(out["success"])
+
+
+def main(out_dir="examples/out/dmtm_metals", n_points=25):
+    n_points = int(n_points)
+    fig_path = os.path.join(out_dir, "figures")
+    csv_path = os.path.join(out_dir, "outputs")
+    os.makedirs(fig_path, exist_ok=True)
+    os.makedirs(csv_path, exist_ok=True)
+
+    base = os.path.join(REFERENCE_ROOT, "examples", "DMTM", "metals")
+    bsOs = np.linspace(start=-6, stop=0, num=n_points, endpoint=True)
+    temperatures = [500, 650, 800]
+
+    for study in ["dry", "wet"]:
+        sys_ = pk.read_from_input_file(
+            os.path.join(base, f"input_{study}_sr.json"))
+        tof = np.zeros((len(temperatures), len(bsOs)))
+        nok = 0
+        for Ti, T in enumerate(temperatures):
+            sys_.params["temperature"] = T
+            apply_gas_entropy_modifiers(sys_, T, sys_.params["pressure"])
+            tof[Ti], success = volcano_slice(sys_, bsOs)
+            nok += int(np.sum(success))
+        print(f"{study}: {nok}/{tof.size} lanes converged")
+
+        header = "TOF (1/s); rows T = " + ", ".join(
+            f"{t} K" for t in temperatures) + "; cols bsO (eV) " \
+            f"[{bsOs[0]}, {bsOs[-1]}] x {len(bsOs)}"
+        np.savetxt(os.path.join(csv_path, f"tof_{study}.csv"), tof,
+                   delimiter=",", header=header)
+
+        fig, ax = plt.subplots(figsize=(4, 3))
+        for Ti, T in enumerate(temperatures):
+            ax.plot(bsOs, np.log10(np.maximum(np.abs(tof[Ti]), 1e-300)),
+                    label=f"{T} K")
+        ax.set(xlabel=r"$E_{\mathsf{*O}}$ (eV)",
+               ylabel=r"$\log_{10}$ TOF (1/s)", title=study)
+        ax.legend(frameon=False)
+        fig.tight_layout()
+        fig.savefig(os.path.join(fig_path, f"volcano_{study}.png"),
+                    dpi=300)
+        plt.close(fig)
+
+    print(f"DMTM metals artifacts written to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:3])

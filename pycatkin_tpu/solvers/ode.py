@@ -52,9 +52,16 @@ class ODEOptions(NamedTuple):
     safety: float = 0.9
     min_factor: float = 0.2
     max_factor: float = 8.0
+    # Stage-Newton iterate clamp: bound on |y| during implicit stage
+    # solves. The default suits the chemistry layer (coverages in [0,1],
+    # gas in bar, so the true state is O(1)); callers integrating
+    # differently-scaled systems must raise it. Runaway iterates past
+    # the clamp would overflow the f32-ranged exponent of TPU's f64
+    # emulation and poison the step controller.
+    clamp: float = 1.0e3
 
 
-def _stage_solve(f, msolve, z0, rhs_const, h, scale):
+def _stage_solve(f, msolve, z0, rhs_const, h, scale, clamp):
     """Solve z = rhs_const + d*h*f(z) by simplified Newton with the frozen
     factorized iteration matrix (I - d*h*J).
 
@@ -67,7 +74,12 @@ def _stage_solve(f, msolve, z0, rhs_const, h, scale):
         z, _ = carry
         res = z - rhs_const - D * h * f(z)
         dz = msolve(res)
-        z_new = z - dz
+        # Clamp runaway iterates (ODEOptions.clamp): an overshooting
+        # iterate feeds k*prod(y) past the exponent range of TPU's
+        # f32-ranged f64 emulation, and the resulting inf/nan would
+        # poison the step controller instead of just costing a
+        # rejection.
+        z_new = jnp.clip(z - dz, -clamp, clamp)
         dz_norm = jnp.sqrt(jnp.mean((dz / scale) ** 2))
         return z_new, dz_norm
     z, dz_norm = jax.lax.fori_loop(0, _NEWTON_ITERS, body,
@@ -76,8 +88,9 @@ def _stage_solve(f, msolve, z0, rhs_const, h, scale):
     return z, converged
 
 
-def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions):
-    """One TR-BDF2 step attempt. Returns (y_new, err_ratio, ok)."""
+def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions, f0=None):
+    """One TR-BDF2 step attempt. Returns (y_new, err_ratio, ok).
+    ``f0``: f(y) if the caller already evaluated it."""
     n = y.shape[0]
     eye = jnp.eye(n, dtype=y.dtype)
     J = jac(y)
@@ -85,18 +98,19 @@ def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions):
     # One factorization serves both stages and the error filter.
     msolve = linalg.make_msolve(M)
 
-    f0 = f(y)
+    if f0 is None:
+        f0 = f(y)
     scale0 = opts.atol + opts.rtol * jnp.abs(y)
     # TR stage to t + gamma*h
     g, conv1 = _stage_solve(f, msolve, y + GAMMA * h * f0,
-                            y + D * h * f0, h, scale0)
+                            y + D * h * f0, h, scale0, opts.clamp)
     fg = f(g)
     # BDF2 stage to t + h
     c_g = 1.0 / (GAMMA * (2.0 - GAMMA))
     c_y = (1.0 - GAMMA) ** 2 / (GAMMA * (2.0 - GAMMA))
     rhs_const = c_g * g - c_y * y
     y1, conv2 = _stage_solve(f, msolve, rhs_const + D * h * fg, rhs_const,
-                             h, scale0)
+                             h, scale0, opts.clamp)
     f1 = f(y1)
 
     # Embedded error, stiffly filtered.
@@ -109,8 +123,13 @@ def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions):
     return y1, jnp.where(ok, err_ratio, jnp.inf), ok
 
 
-def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions):
-    """Adaptively integrate from t0 to t1. Returns (y(t1), last_h, ok)."""
+def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
+                steady_fn=None):
+    """Adaptively integrate from t0 to t1. Returns (y(t1), last_h, ok).
+
+    ``steady_fn(y) -> bool``: optional oracle declaring y steady (e.g.
+    the engine's net-vs-gross flux test); when it fires, the remaining
+    span is skipped (y(t1) = y)."""
 
     def cond(state):
         y, t, h, k, ok = state
@@ -118,17 +137,45 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions):
 
     def body(state):
         y, t, h, k, ok = state
-        h_try = jnp.minimum(h, t1 - t)
-        y_new, err_ratio, step_ok = _trbdf2_step(f, jac, y, t, h_try, opts)
+        # Integrate-to-steady shortcut: once even a constant-derivative
+        # extrapolation over the WHOLE remaining span stays within the
+        # error tolerance, y is steady to working accuracy and the
+        # segment is done. Without this, huge trailing spans (the
+        # reference's times=[0, 1e12..1e16] pattern) stall: near steady
+        # state (I - d*h*J) inherits the conservation null space of J at
+        # large h, the stage Newton degrades, and h plateaus until
+        # max_steps is burned.
+        f0 = f(y)
+        remaining = t1 - t
+        steady = jnp.all(jnp.abs(f0) * remaining
+                         <= opts.atol + opts.rtol * jnp.abs(y))
+        if steady_fn is not None:
+            # The span criterion above cannot distinguish a genuinely
+            # drifting state from f64 cancellation noise (net flux ~
+            # eps * gross flux) over huge remaining spans; the domain
+            # oracle can.
+            steady = steady | steady_fn(y)
+        h_try = jnp.minimum(h, remaining)
+        final = h >= remaining
+        y_new, err_ratio, step_ok = _trbdf2_step(f, jac, y, t, h_try, opts,
+                                                 f0=f0)
         accept = step_ok & (err_ratio <= 1.0)
         factor = jnp.where(
             err_ratio > 0,
             opts.safety * err_ratio ** (-1.0 / 3.0),
             opts.max_factor)
+        # jnp.clip propagates NaN: a non-finite factor (overflowed error
+        # estimate on TPU's range-limited f64) must read as "shrink",
+        # not poison h for the rest of the integration.
+        factor = jnp.where(jnp.isfinite(factor), factor, opts.min_factor)
         factor = jnp.clip(factor, opts.min_factor, opts.max_factor)
         h_next = jnp.maximum(h_try * factor, 1e-300)
-        y = jnp.where(accept, y_new, y)
-        t = jnp.where(accept, t + h_try, t)
+        y = jnp.where(accept & ~steady, y_new, y)
+        # Land exactly on t1 when the step spans the remainder: t + h_try
+        # can round to 1 ulp below t1, leaving a no-progress tail loop.
+        t = jnp.where(steady, t1,
+                      jnp.where(accept, jnp.where(final, t1, t + h_try), t))
+        h_next = jnp.where(steady, h, h_next)
         # Declare failure only on persistent step collapse.
         still_ok = ok & (h_next > 1e-250)
         return (y, t, h_next, k + 1, still_ok)
@@ -141,15 +188,18 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions):
 
 
 def integrate(f: Callable, jac: Callable, y0: jnp.ndarray,
-              save_ts: jnp.ndarray, opts: ODEOptions = ODEOptions()):
+              save_ts: jnp.ndarray, opts: ODEOptions = ODEOptions(),
+              steady_fn=None):
     """Integrate y' = f(y) (autonomous) and return y at ``save_ts``.
 
     save_ts: increasing times, save_ts[0] is the initial time (y0 is
     reported there). Returns (ys [len(save_ts), n], ok).
+    ``steady_fn``: optional steadiness oracle, see :func:`_advance_to`.
     """
     def scan_body(carry, t_next):
         y, t, h, ok = carry
-        y_new, h_new, seg_ok = _advance_to(f, jac, y, t, t_next, h, opts)
+        y_new, h_new, seg_ok = _advance_to(f, jac, y, t, t_next, h, opts,
+                                           steady_fn=steady_fn)
         ok = ok & seg_ok
         return (y_new, t_next, h_new, ok), y_new
 

@@ -19,8 +19,6 @@ import os
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
 
-_enabled = False
-
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir``.
@@ -33,15 +31,21 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
 
     Returns the cache directory in use. Safe to call repeatedly.
     """
-    global _enabled
     if cache_dir is None:
         cache_dir = os.environ.get("PYCATKIN_JAX_CACHE_DIR", _DEFAULT_DIR)
-    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        # Read-only install (e.g. system site-packages): fall back to a
+        # per-user cache rather than aborting the entry point.
+        import tempfile
+        cache_dir = os.path.join(tempfile.gettempdir(),
+                                 f"pycatkin_jax_cache_{os.getuid()}")
+        os.makedirs(cache_dir, exist_ok=True)
 
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    _enabled = True
     return cache_dir
