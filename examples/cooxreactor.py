@@ -7,8 +7,8 @@ batched program per system instead of the reference's serial loop),
 write pressure/coverage CSVs and the two-catalyst conversion figure.
 
 The reference also exports .pdb structure files via ASE
-(cooxreactor.py:18-25); structure I/O is out of scope here (no ASE),
-the kinetics workflow is complete.
+(cooxreactor.py:18-25); here the native writer does the same (the
+interactive ASE viewer of draw_states has no headless counterpart).
 
 Usage:  python examples/cooxreactor.py [output_dir]
 Artifacts: outputs/{AuPd,Pd111}/*.csv, figures/conversion.png.
@@ -28,12 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pycatkin_tpu as pk
 from pycatkin_tpu.api.plotting import plot_data_simple
-from pycatkin_tpu.api.presets import run_temperatures
+from pycatkin_tpu.api.presets import run_temperatures, save_structures
 
 REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
 
 
-def main(out_dir="examples/out/cooxreactor"):
+def main(out_dir="examples/out/cooxreactor", n_T=20):
+    n_T = int(n_T)
     fig_path = os.path.join(out_dir, "figures") + os.sep
     os.makedirs(fig_path, exist_ok=True)
 
@@ -43,7 +44,13 @@ def main(out_dir="examples/out/cooxreactor"):
     sim_system_Pd = pk.read_from_input_file(
         os.path.join(base, "input_Pd111.json"))
 
-    temperatures = np.linspace(start=423, stop=623, num=20, endpoint=True)
+    # Save the Pd111 non-TS structures in .pdb format
+    # (cooxreactor.py:22-25).
+    written = save_structures(sim_system_Pd,
+                              fig_path=os.path.join(fig_path, "Pd111"))
+    print(f"saved {len(written)} Pd111 structures as .pdb")
+
+    temperatures = np.linspace(start=423, stop=623, num=n_T, endpoint=True)
     fig, ax = None, None
     for sysname, sim_system in [["AuPd", sim_system_Au],
                                 ["Pd111", sim_system_Pd]]:
@@ -71,4 +78,4 @@ def main(out_dir="examples/out/cooxreactor"):
 
 
 if __name__ == "__main__":
-    main(*sys.argv[1:2])
+    main(*sys.argv[1:3])

@@ -37,7 +37,8 @@ from pycatkin_tpu.api.presets import (run, run_energy_span_temperatures,
 REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
 
 
-def main(out_dir="examples/out/dmtm"):
+def main(out_dir="examples/out/dmtm", n_T=17):
+    n_T = int(n_T)
     fig_path = os.path.join(out_dir, "figures") + os.sep
     csv_path = os.path.join(out_dir, "outputs") + os.sep
 
@@ -64,7 +65,7 @@ def main(out_dir="examples/out/dmtm"):
 
     # Temperature sweep with steady solve + DRC as one batched program
     # (dmtm.py:40-59).
-    temperatures = np.linspace(start=400, stop=800, num=17, endpoint=True)
+    temperatures = np.linspace(start=400, stop=800, num=n_T, endpoint=True)
     run_temperatures(sim_system=sim_system, temperatures=temperatures,
                      tof_terms=["r5", "r9"], steady_state_solve=True,
                      plot_results=True, save_results=True,
@@ -85,4 +86,4 @@ def main(out_dir="examples/out/dmtm"):
 
 
 if __name__ == "__main__":
-    main(*sys.argv[1:2])
+    main(*sys.argv[1:3])

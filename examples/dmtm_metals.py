@@ -57,6 +57,49 @@ MINIMA = [
 ]
 
 
+# The sr inputs point their 17 scaling-relation states at per-metal
+# vibration files ("aupd/data/vibrations/...") that are NOT shipped in
+# the repository -- the reference script itself needs an external data
+# tree (dmtm_metals_sr.py:19 base_out_dir). To keep the workflow
+# runnable end-to-end with shipped data, substitute the Cu-frame
+# vibrational data of the main DMTM dataset (same adsorbate frames,
+# Cu naming); rad1/rad2 use their flanking radical-rebound saddle
+# frames TS3/TS4. The descriptor axis overrides the energetics, so
+# this substitution only sets the vibrational prefactor scale.
+CU_VIBS = {
+    "2s": "2Cu", "s-pair": "Cu-pair", "s-pair.1": "Cu-pair",
+    "sO2s": "CuO2Cu", "sOOs": "CuOOCu", "s2Och4": "s2OCH4",
+    "sOsCH3OH": "sOsCH3OH", "sOch4": "sOCH4", "sOHsCH3": "sOHsCH3",
+    "sCH3OH": "sCH3OH", "s": "s", "ts1": "TS1", "ts2": "TS2",
+    "rad1": "TS3", "rad2": "TS4", "ts5": "TS5", "ts6": "TS6",
+}
+
+
+def patched_input(study, out_dir):
+    """Write a loadable copy of input_<study>_sr.json with the missing
+    per-metal vibration paths remapped to the shipped Cu data."""
+    import json
+    base = os.path.join(REFERENCE_ROOT, "examples", "DMTM", "metals")
+    vib_dir = os.path.join(REFERENCE_ROOT, "examples", "DMTM", "data",
+                           "vibrations")
+    with open(os.path.join(base, f"input_{study}_sr.json")) as fh:
+        cfg = json.load(fh)
+    # The patched copy lives in out_dir, so absolutize every data path
+    # against the original input directory.
+    for st in cfg.get("states", {}).values():
+        for key in ("path", "vibs_path"):
+            if key in st and not os.path.isabs(st[key]):
+                st[key] = os.path.normpath(os.path.join(base, st[key]))
+    for name, st in cfg["scaling relation states"].items():
+        if "vibs_path" in st:
+            st["vibs_path"] = os.path.join(
+                vib_dir, f"{CU_VIBS[name]}_frequencies.dat")
+    path = os.path.join(out_dir, f"input_{study}_sr_patched.json")
+    with open(path, "w") as fh:
+        json.dump(cfg, fh)
+    return path
+
+
 def apply_gas_entropy_modifiers(sys_, T, p):
     """Reference dmtm_metals_sr.py:76-88: subtract the entropy of gases
     consumed relative to the first minimum; partially restore CH4's
@@ -100,13 +143,11 @@ def main(out_dir="examples/out/dmtm_metals", n_points=25):
     os.makedirs(fig_path, exist_ok=True)
     os.makedirs(csv_path, exist_ok=True)
 
-    base = os.path.join(REFERENCE_ROOT, "examples", "DMTM", "metals")
     bsOs = np.linspace(start=-6, stop=0, num=n_points, endpoint=True)
     temperatures = [500, 650, 800]
 
     for study in ["dry", "wet"]:
-        sys_ = pk.read_from_input_file(
-            os.path.join(base, f"input_{study}_sr.json"))
+        sys_ = pk.read_from_input_file(patched_input(study, out_dir))
         tof = np.zeros((len(temperatures), len(bsOs)))
         nok = 0
         for Ti, T in enumerate(temperatures):

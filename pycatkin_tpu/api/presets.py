@@ -364,6 +364,22 @@ def write_results(sim_system, path=""):
         os.path.join(path, f"pressures_{tag}.csv"), index=False)
 
 
+def save_structures(sim_system, fig_path="", types_to_skip=("TS",)):
+    """Export every state's structure as .pdb (the file-artifact half of
+    the reference's draw_states preset, presets.py:308-320 +
+    cooxreactor.py:22-25; the interactive ASE viewer itself has no
+    headless counterpart and is out of scope). Returns {name: path} for
+    the states that had structure data."""
+    written = {}
+    for name, st in sim_system.states.items():
+        if st.state_type in types_to_skip:
+            continue
+        fname = st.save_pdb(path=fig_path)
+        if fname:
+            written[name] = fname
+    return written
+
+
 def get_tof_for_given_reactions(sim_system, tof_terms):
     """Sum of net rates of the named steps at the last transient solution
     (reference presets.py:585-597)."""

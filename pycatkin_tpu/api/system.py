@@ -259,7 +259,8 @@ class System:
         n_out = n_out or self.params.get("n_out", 300)
         grid = np.asarray(log_time_grid(times[0], times[-1], n_out))
         cond = self.conditions()
-        ys, ok = engine.transient(self.spec, cond, grid, self._ode_options())
+        ys, ok = engine.transient_chunked(self.spec, cond, grid,
+                                          self._ode_options())
         self.times = grid
         self.solution = np.asarray(ys)
         if not bool(ok):
@@ -292,6 +293,16 @@ class System:
             x0 = self.solution[-1][self.spec.dynamic_indices]
         res = engine.steady_state(self.spec, cond, x0=x0, key=key,
                                   opts=solver_opts)
+        if not bool(res.success):
+            # Strategy fallback (reference solve_root -> solve_minimize
+            # chain): re-solve with projected-LM descent from the best
+            # PTC iterate.
+            lm = engine.steady_state(
+                self.spec, cond,
+                x0=np.asarray(res.x)[self.spec.dynamic_indices],
+                key=key, opts=solver_opts, strategy="lm")
+            if bool(lm.success):
+                res = lm
         if check_stability and bool(res.success):
             import jax
             k = key if key is not None else jax.random.PRNGKey(1)

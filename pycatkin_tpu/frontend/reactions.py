@@ -37,13 +37,14 @@ class Reaction:
 
     def __post_init__(self):
         rt = str(self.reac_type).lower()
-        if rt == "scaling":
-            # Reference-schema alias (e.g. COOxReactor input_AuPd.json
-            # "CO_ox"): a TS-mediated surface step between scaling
-            # states. The reference has no explicit branch for it -- it
-            # lands in the activated path through the ``or self.dGa_fwd``
-            # condition (reaction.py:121) -- which is exactly the
-            # arrhenius dispatch here.
+        if rt in ("scaling", "base"):
+            # Reference-schema aliases ('scaling': COOxReactor
+            # input_AuPd.json "CO_ox"; 'base': DMTM metals
+            # input_*_sr.json r0-r10, the TS-mediated donor steps for
+            # derived reactions). The reference has no explicit branch
+            # for either -- they land in the activated path through the
+            # ``or self.dGa_fwd`` condition (reaction.py:121), which is
+            # exactly the arrhenius dispatch here.
             rt = ARRHENIUS
         if rt not in REAC_TYPES:
             raise ValueError(

@@ -206,6 +206,46 @@ class State:
     def set_energy_modifier(self, modifier):
         self.add_to_energy = modifier
 
+    def get_structure(self):
+        """(symbols, positions [A]) of the final ionic step, read from the
+        state's OUTCAR. None when the state has no structure source."""
+        if self.path is None:
+            return None
+        try:
+            outcar = parsers.resolve_outcar_path(self.path)
+            data = parsers.read_outcar(outcar)
+        except (OSError, ValueError):
+            return None
+        return data["symbols"], data["positions"]
+
+    def save_pdb(self, path: str = ""):
+        """Write the state's structure as a .pdb file (reference
+        state.py:413-434 via ase.io.write; native minimal writer here).
+        Returns the file path, or None when no structure is available."""
+        struct = self.get_structure()
+        if struct is None:
+            return None
+        symbols, positions = struct
+        import os
+        if path:
+            os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, f"{self.name}.pdb")
+        with open(fname, "w") as fh:
+            fh.write(f"TITLE     {self.name}\n")
+            for i, (sym, (x, y, z)) in enumerate(zip(symbols, positions),
+                                                 start=1):
+                # Fixed columns per the PDB spec: serial 7-11, name
+                # 13-16, altLoc 17, resName 18-20, chain 22, resSeq
+                # 23-26, x/y/z 31-54, occupancy 55-60, tempFactor
+                # 61-66, element 77-78 (right-justified, so two-letter
+                # species like Pd survive strict readers).
+                fh.write(
+                    f"HETATM{i:>5d} {sym:<4s} MOL A{1:>4d}    "
+                    f"{x:8.3f}{y:8.3f}{z:8.3f}{1.0:6.2f}{0.0:6.2f}"
+                    f"          {sym:>2s}\n")
+            fh.write("END\n")
+        return fname
+
     @property
     def is_scaling(self) -> bool:
         return False

@@ -42,18 +42,23 @@ from ..solvers.ode import ODEOptions
 # :func:`clear_program_caches` lets long-running sessions (one System per
 # UQ copy, loops over mechanisms) release device memory explicitly.
 def clear_program_caches():
-    """Drop all cached jitted programs (and their spec references)."""
+    """Drop all cached jitted programs (and their spec references),
+    including the engine-level transient chunk/finish programs."""
     _steady_program.cache_clear()
-    _transient_program.cache_clear()
+    _transient_chunk_program.cache_clear()
+    _transient_finish_program.cache_clear()
     _tof_program.cache_clear()
     _jacobian_program.cache_clear()
+    engine._transient_chunk_program.cache_clear()
+    engine._transient_finish_program.cache_clear()
 
 
 @lru_cache(maxsize=16)
 def _steady_program(spec: ModelSpec, opts: SolverOptions,
-                    out_sharding=None):
+                    out_sharding=None, strategy: str = "ptc"):
     def solve_one(cond, key, x0):
-        return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts)
+        return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
+                                   strategy=strategy)
     fn = jax.vmap(solve_one)
     if out_sharding is not None:
         return jax.jit(fn, out_shardings=out_sharding)
@@ -61,10 +66,17 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
 
 
 @lru_cache(maxsize=16)
-def _transient_program(spec: ModelSpec, opts: ODEOptions):
-    def solve_one(cond, save_ts):
-        return engine.transient(spec, cond, save_ts, opts)
-    return jax.jit(jax.vmap(solve_one, in_axes=(0, None)))
+def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
+    def run_one(cond, state, part):
+        return engine.transient_state(spec, cond, state, part, opts)
+    return jax.jit(jax.vmap(run_one, in_axes=(0, 0, None)))
+
+
+@lru_cache(maxsize=16)
+def _transient_finish_program(spec: ModelSpec):
+    def fin_one(cond, y_last, ok):
+        return engine.transient_finish(spec, cond, y_last, ok)
+    return jax.jit(jax.vmap(fin_one))
 
 
 @lru_cache(maxsize=16)
@@ -141,19 +153,28 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
 
 def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
                     opts: ODEOptions = ODEOptions(),
-                    mesh: Optional[Mesh] = None):
-    """Integrate every lane's transient in one device program.
+                    mesh: Optional[Mesh] = None, chunk: int = 8):
+    """Integrate every lane's transient, the save grid chunked into
+    bounded device calls driven from the host (one compiled program per
+    chunk shape; a single monolithic kernel integrating hundreds of
+    intervals for the slowest lane can run for minutes and trip
+    execution watchdogs on shared TPU runtimes).
     Returns (ys [lanes, t, n_s], ok [lanes])."""
-    save_ts = jnp.asarray(save_ts)
-    if mesh is None:
-        return _transient_program(spec, opts)(conds, save_ts)
-    n_dev = mesh.devices.size
-    conds_p, n = _pad_lanes(conds, n_dev)
-    axis = mesh.axis_names[0]
-    sharding = NamedSharding(mesh, P(axis))
-    conds_p = jax.device_put(conds_p, sharding)
-    ys, ok = _transient_program(spec, opts)(conds_p, save_ts)
-    return ys[:n], ok[:n]
+    n = None
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        conds, n = _pad_lanes(conds, n_dev)
+        axis = mesh.axis_names[0]
+        conds = jax.device_put(conds, NamedSharding(mesh, P(axis)))
+
+    ys, ok = engine.chunked_transient_drive(
+        _transient_chunk_program(spec, opts),
+        _transient_finish_program(spec),
+        conds, jnp.asarray(conds.y0, dtype=jnp.float64), save_ts, opts,
+        chunk, batched=True)
+    if n is not None:
+        return ys[:n], ok[:n]
+    return ys, ok
 
 
 @lru_cache(maxsize=16)
@@ -190,6 +211,45 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     return out
 
 
+def _rescue(spec: ModelSpec, conds: Conditions, res,
+            opts: SolverOptions, strategy: str, pad_to: int = 64):
+    """Host-side second pass over FAILED lanes only: re-solve the failed
+    subset with the given strategy/options from the best iterates of the
+    first pass. Padded to a multiple of ``pad_to`` so recompiles stay
+    rare. The hot batched path never pays for stragglers: a handful of
+    hard lanes otherwise force every lane through the full retry ladder
+    (SIMD executes the union of all lanes' work)."""
+    fail = ~np.asarray(res.success)
+    if not fail.any():
+        return res
+    idx = np.flatnonzero(fail)
+    n_pad = -len(idx) % pad_to
+    idx_p = np.concatenate([idx, np.repeat(idx[:1], n_pad)])
+    sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx_p], conds)
+    x0 = jnp.asarray(res.x)[idx_p][:, jnp.asarray(spec.dynamic_indices)]
+    keys = jax.random.split(jax.random.PRNGKey(1), len(idx_p))
+    out = _steady_program(spec, opts, strategy=strategy)(sub, keys, x0)
+    got = np.asarray(out.success)[:len(idx)]
+    if not got.any():
+        return res
+    x = np.array(res.x)
+    succ = np.array(res.success)
+    resid = np.array(res.residual)
+    iters = np.array(res.iterations)
+    atts = np.array(res.attempts)
+    x[idx[got]] = np.asarray(out.x)[:len(idx)][got]
+    succ[idx[got]] = True
+    resid[idx[got]] = np.asarray(out.residual)[:len(idx)][got]
+    # Diagnostics accumulate across passes: the hardest lanes must
+    # report their true total cost, not the capped fast-pass numbers.
+    iters[idx] += np.asarray(out.iterations)[:len(idx)]
+    atts[idx] += np.asarray(out.attempts)[:len(idx)]
+    return res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
+                        residual=jnp.asarray(resid),
+                        iterations=jnp.asarray(iters),
+                        attempts=jnp.asarray(atts))
+
+
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
                        x0=None, opts: SolverOptions = SolverOptions(),
                        mesh: Optional[Mesh] = None,
@@ -203,7 +263,15 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     verdict) are demoted to success=False and reported under 'stable' --
     grid triage then treats them like any other failed lane.
     """
-    res = batch_steady_state(spec, conds, x0=x0, opts=opts, mesh=mesh)
+    # Two-phase solve: a capped single-attempt first pass (sized for the
+    # ~p99 lane), then host-side rescue of the failed subset with the
+    # full retry ladder, then the LM strategy fallback. Stragglers no
+    # longer drag every lane through the whole retry ladder.
+    fast = opts._replace(max_steps=min(opts.max_steps, 100),
+                         max_attempts=1)
+    res = batch_steady_state(spec, conds, x0=x0, opts=fast, mesh=mesh)
+    res = _rescue(spec, conds, res, opts, "ptc")
+    res = _rescue(spec, conds, res, opts, "lm")
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts}
     if check_stability:

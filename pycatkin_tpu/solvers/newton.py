@@ -167,10 +167,8 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     return x, fnorm, k
 
 
-def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
-    """Convergence tests (reference solver.py:69-120 minus the host-only
-    eigenvalue check): normalized residual small, coverages non-negative,
-    each site group sums to ~1."""
+def _verdict_tests(x, fnorm, groups_dyn, opts: SolverOptions):
+    """The three on-device convergence tests as separate flags."""
     rate_ok = fnorm <= 1.0
     pos_ok = jnp.all(x >= -opts.neg_tol)
     sums = groups_dyn @ x
@@ -178,53 +176,141 @@ def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
     sums_ok = jnp.all(jnp.where(have_group,
                                 jnp.abs(sums - 1.0) <= opts.coverage_tol,
                                 True))
+    return rate_ok, pos_ok, sums_ok
+
+
+def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
+    """Convergence tests (reference solver.py:69-120 minus the host-only
+    eigenvalue check): normalized residual small, coverages non-negative,
+    each site group sums to ~1."""
+    rate_ok, pos_ok, sums_ok = _verdict_tests(x, fnorm, groups_dyn, opts)
     return rate_ok & pos_ok & sums_ok
+
+
+def _score(x, fnorm, groups_dyn, opts: SolverOptions):
+    """Lexicographic solution score (reference SolScore +
+    compare_scores, solver.py:8-15,143-219): candidates are ranked
+    first by how many convergence tests they pass, then by residual.
+    Encoded as a single float: tests_passed * BIG - min(fnorm, BIG/2),
+    with BIG small enough that the residual term survives f64 rounding
+    (residual differences beyond BIG/2 don't rank -- both candidates are
+    garbage there anyway); HIGHER is better."""
+    rate_ok, pos_ok, sums_ok = _verdict_tests(x, fnorm, groups_dyn, opts)
+    passed = (jnp.asarray(rate_ok, x.dtype) + jnp.asarray(pos_ok, x.dtype)
+              + jnp.asarray(sums_ok, x.dtype))
+    big = 1.0e6
+    return passed * big - jnp.minimum(fnorm, 0.5 * big)
+
+
+def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
+    """Projected Levenberg-Marquardt minimization of the scaled residual
+    norm -- the device analog of the reference's ``solve_minimize``
+    strategy (solver.py:293-372: scipy minimize of max|residual| with
+    bounds [0,1]). Where PTC marches pseudo-time, this descends
+    ||F/scale||^2 directly, which escapes regions where the pseudo-time
+    march cycles. Same projection (clamp + group renormalization) keeps
+    iterates physical. Returns (x, normalized_residual, steps)."""
+    n = x0.shape[0]
+    eye = jnp.eye(n, dtype=x0.dtype)
+    R, M = conservation_constraints(groups_dyn)
+
+    def scaled(x):
+        F, gross = fscale_fn(x)
+        scale = opts.rate_tol + opts.rate_tol_rel * gross
+        return F / scale, jnp.max(jnp.abs(F) / scale)
+
+    def cond(state):
+        x, r, fnorm, lam, k = state
+        return (k < opts.max_steps) & (fnorm > 1.0)
+
+    def body(state):
+        x, r, fnorm, lam, k = state
+        # Frozen-scale Gauss-Newton model of the scaled residual; the
+        # conservation rows replace their linearly-dependent partners
+        # exactly as in the PTC step.
+        F, gross = fscale_fn(x)
+        scale = opts.rate_tol + opts.rate_tol_rel * gross
+        J = jac_fn(x) / scale[:, None]
+        A = jnp.where(M[:, None] > 0, R, J.T @ J + lam * eye)
+        g = jnp.where(M > 0, 0.0, J.T @ (F / scale))
+        dx = linalg.solve(A, -g * (1.0 - M))
+        x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
+                           opts.floor)
+        r_new, fnorm_new = scaled(x_new)
+        finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
+        accept = finite & (fnorm_new < fnorm)
+        lam_new = jnp.where(accept, jnp.maximum(lam / 3.0, 1e-12),
+                            jnp.minimum(lam * 10.0, 1e12))
+        return (jnp.where(accept, x_new, x),
+                jnp.where(accept, r_new, r),
+                jnp.where(accept, fnorm_new, fnorm),
+                lam_new, k + 1)
+
+    r0, f0 = scaled(x0)
+    x, r, fnorm, lam, k = jax.lax.while_loop(
+        cond, body, (x0, r0, f0, jnp.asarray(1e-3, x0.dtype), 0))
+    return x, fnorm, k
 
 
 def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                  groups_dyn: jnp.ndarray, opts: SolverOptions,
-                 key: jnp.ndarray | None = None):
+                 key: jnp.ndarray | None = None,
+                 strategy: str = "ptc"):
     """Robust steady solve of ``F(x) = 0`` for the dynamic vector.
 
     ``fscale_fn(x) -> (F, gross)``: residual plus per-species gross-flux
     scale (see :func:`_rnorm` for the convergence measure).
     groups_dyn: [n_g, n_dyn] conservation groups restricted to the dynamic
     indices (used for retry renormalization and the verdict).
+    ``strategy``: 'ptc' (pseudo-transient Newton, the default and the
+    batched hot path) or 'lm' (projected Levenberg-Marquardt descent of
+    the scaled residual -- the reference's solve_minimize analog,
+    solver.py:293-372). The choice is static: under ``vmap`` a runtime
+    branch would execute BOTH solvers for every lane; callers instead
+    re-run failed lanes with 'lm' in a second pass (the reference's own
+    sequential strategy fallback).
     Returns (x, success, normalized_residual, iterations, attempts).
     """
+    attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
     if key is None:
         key = jax.random.PRNGKey(0)
 
     def attempt_cond(state):
-        x, best_x, best_f, success, iters, attempt, key = state
+        x, best_x, best_f, best_s, success, iters, attempt, key = state
         return (attempt < opts.max_attempts) & (~success)
 
     def attempt_body(state):
-        x, best_x, best_f, success, iters, attempt, key = state
+        x, best_x, best_f, best_s, success, iters, attempt, key = state
         # Attempt 0 trusts the caller's guess verbatim: even a 1e-9
-        # renormalization perturbs residuals by k_max * 1e-9, and restarts
-        # risk hopping to a different steady-state branch. Attempt 1
-        # renormalizes (reference system.py:630), attempts >= 2 restart
-        # from random guesses (reference system.py:586).
+        # renormalization perturbs residuals by k_max * 1e-9, and
+        # restarts risk hopping to a different steady-state branch.
+        # Attempt 1 renormalizes (reference system.py:630); attempts
+        # >= 2 restart from random guesses (reference system.py:586).
         x_norm = _normalize(jnp.abs(x), groups_dyn, opts.floor)
         key, sub = jax.random.split(key)
         rand = _normalize(jax.random.uniform(sub, x.shape, dtype=x.dtype),
                           groups_dyn, opts.floor)
         x_start = jnp.where(attempt == 0, x,
                             jnp.where(attempt == 1, x_norm, rand))
-        x_new, fnorm, k = _ptc_attempt(fscale_fn, jac_fn, x_start,
-                                       groups_dyn, opts)
+        x_new, fnorm, k = attempt_fn(fscale_fn, jac_fn, x_start,
+                                     groups_dyn, opts)
         ok = _verdict(x_new, fnorm, groups_dyn, opts)
-        better = fnorm < best_f
+        # Lexicographic scoreboard across attempts (reference
+        # compare_scores): tests passed first, residual second.
+        s_new = _score(x_new, fnorm, groups_dyn, opts)
+        better = s_new > best_s
         best_x = jnp.where(better, x_new, best_x)
         best_f = jnp.where(better, fnorm, best_f)
-        return (x_new, best_x, best_f, ok, iters + k, attempt + 1, key)
+        best_s = jnp.where(better, s_new, best_s)
+        return (x_new, best_x, best_f, best_s, ok, iters + k,
+                attempt + 1, key)
 
     F0, gross0 = fscale_fn(x0)
     f0 = _rnorm(F0, gross0, opts)
-    init = (x0, x0, f0, jnp.asarray(False), 0, 0, key)
-    x, best_x, best_f, success, iters, attempts, _ = jax.lax.while_loop(
-        attempt_cond, attempt_body, init)
+    s0 = _score(x0, f0, groups_dyn, opts)
+    init = (x0, x0, f0, s0, jnp.asarray(False), 0, 0, key)
+    (x, best_x, best_f, best_s, success, iters, attempts,
+     _) = jax.lax.while_loop(attempt_cond, attempt_body, init)
     x_out = jnp.where(success, x, best_x)
     Fx, grossx = fscale_fn(x)
     f_out = jnp.where(success, _rnorm(Fx, grossx, opts), best_f)

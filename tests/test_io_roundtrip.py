@@ -122,3 +122,25 @@ def test_run_timed_blocks():
     result, seconds = run_timed(f, jnp.arange(1000.0), repeats=2)
     assert float(result) == pytest.approx(sum(i * i for i in range(1000)))
     assert seconds >= 0.0
+
+
+def test_save_pdb_structures(ref_root, tmp_path):
+    """Native .pdb export from OUTCAR structure data (reference
+    state.py:413-434 / test_3.py saves Pd111 states as pdb)."""
+    import pycatkin_tpu as pk
+    from pycatkin_tpu.api.presets import save_structures
+    from tests.conftest import reference_path
+
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxReactor", "input_Pd111.json"))
+    written = save_structures(sim, fig_path=str(tmp_path))
+    assert written, "no structures exported"
+    name, fname = next(iter(written.items()))
+    text = open(fname).read()
+    assert text.startswith("TITLE")
+    assert "HETATM" in text and text.rstrip().endswith("END")
+    # CO gas: two atoms, carbon + oxygen
+    if "CO" in written:
+        co = open(written["CO"]).read().splitlines()
+        atoms = [ln for ln in co if ln.startswith("HETATM")]
+        assert len(atoms) == 2

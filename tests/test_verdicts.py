@@ -342,3 +342,59 @@ def test_multi_surface_leftover_warns():
     assert spec.groups.shape[0] == 2
     gb = next(g for g in spec.groups if g[spec.sindex("b")] == 1.0)
     assert gb[spec.sindex("zq")] == 1.0
+
+
+# ---------------------------------------------------------------------
+# solve_minimize analog: projected LM strategy + lexicographic scoreboard
+def test_lm_attempt_converges_on_volcano(ref_root):
+    """The projected-LM strategy (reference solve_minimize,
+    solver.py:293-372) independently reaches the same steady state the
+    PTC march finds, from a deliberately bad uniform start."""
+    import jax.numpy as jnp
+
+    import pycatkin_tpu as pk
+    import tests.test_golden_volcano as gv
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.solvers import newton
+    from tests.conftest import reference_path
+
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+    gv.set_descriptors(sim, -1.0, -1.0)
+    spec, cond = sim.spec, sim.conditions()
+    kf, kr, _ = engine.rate_constants(spec, cond)
+    fscale, dyn, y_base = engine._dynamic_fscale(spec, cond, kf, kr)
+    import jax
+    jac = jax.jacfwd(lambda x: fscale(x)[0])
+    groups_dyn = jnp.asarray(spec.groups)[:, jnp.asarray(dyn)]
+    n = len(np.asarray(dyn))
+    x0 = jnp.full((n,), 1.0 / n)
+
+    opts = newton.SolverOptions()
+    x_lm, f_lm, _ = newton._lm_attempt(fscale, jac, x0, groups_dyn, opts)
+    assert float(f_lm) <= 1.0, "LM did not converge"
+
+    res = engine.steady_state(spec, cond)
+    x_ref = jnp.asarray(res.x)[jnp.asarray(dyn)]
+    assert np.allclose(np.asarray(x_lm), np.asarray(x_ref), atol=1e-6)
+
+
+def test_lexicographic_score_ordering():
+    """A candidate passing more verdict tests outranks any residual
+    advantage; ties break on residual (reference compare_scores)."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu.solvers import newton
+
+    groups = jnp.asarray([[1.0, 1.0]])
+    opts = newton.SolverOptions()
+    good = jnp.asarray([0.4, 0.6])       # physical, sums to 1
+    bad = jnp.asarray([-0.5, 0.2])       # negative + broken sum
+    # bad has a (much) smaller residual but fails two tests:
+    s_good = newton._score(good, 0.9, groups, opts)
+    s_bad = newton._score(bad, 1e-6, groups, opts)
+    assert float(s_good) > float(s_bad)
+    # tie on tests -> smaller residual wins
+    s1 = newton._score(good, 0.9, groups, opts)
+    s2 = newton._score(good, 0.2, groups, opts)
+    assert float(s2) > float(s1)
