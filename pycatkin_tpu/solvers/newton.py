@@ -214,21 +214,18 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     eye = jnp.eye(n, dtype=x0.dtype)
     R, M = conservation_constraints(groups_dyn)
 
-    def scaled(x):
-        F, gross = fscale_fn(x)
-        scale = opts.rate_tol + opts.rate_tol_rel * gross
-        return F / scale, jnp.max(jnp.abs(F) / scale)
-
     def cond(state):
-        x, r, fnorm, lam, k = state
+        x, F, gross, fnorm, lam, k = state
         return (k < opts.max_steps) & (fnorm > 1.0)
 
     def body(state):
-        x, r, fnorm, lam, k = state
+        # (F, gross) at x ride the carry, so each iteration evaluates
+        # the residual exactly once (at the trial point) -- XLA cannot
+        # CSE across the while-loop boundary.
+        x, F, gross, fnorm, lam, k = state
         # Frozen-scale Gauss-Newton model of the scaled residual; the
         # conservation rows replace their linearly-dependent partners
         # exactly as in the PTC step.
-        F, gross = fscale_fn(x)
         scale = opts.rate_tol + opts.rate_tol_rel * gross
         J = jac_fn(x) / scale[:, None]
         A = jnp.where(M[:, None] > 0, R, J.T @ J + lam * eye)
@@ -236,19 +233,23 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         dx = linalg.solve(A, -g * (1.0 - M))
         x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
                            opts.floor)
-        r_new, fnorm_new = scaled(x_new)
+        F_new, gross_new = fscale_fn(x_new)
+        fnorm_new = jnp.max(jnp.abs(F_new) /
+                            (opts.rate_tol + opts.rate_tol_rel * gross_new))
         finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
         accept = finite & (fnorm_new < fnorm)
         lam_new = jnp.where(accept, jnp.maximum(lam / 3.0, 1e-12),
                             jnp.minimum(lam * 10.0, 1e12))
         return (jnp.where(accept, x_new, x),
-                jnp.where(accept, r_new, r),
+                jnp.where(accept, F_new, F),
+                jnp.where(accept, gross_new, gross),
                 jnp.where(accept, fnorm_new, fnorm),
                 lam_new, k + 1)
 
-    r0, f0 = scaled(x0)
-    x, r, fnorm, lam, k = jax.lax.while_loop(
-        cond, body, (x0, r0, f0, jnp.asarray(1e-3, x0.dtype), 0))
+    F0, gross0 = fscale_fn(x0)
+    f0 = jnp.max(jnp.abs(F0) / (opts.rate_tol + opts.rate_tol_rel * gross0))
+    x, F, gross, fnorm, lam, k = jax.lax.while_loop(
+        cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-3, x0.dtype), 0))
     return x, fnorm, k
 
 
