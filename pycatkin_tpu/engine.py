@@ -208,16 +208,23 @@ def _dynamic_residual(spec: ModelSpec, cond: Conditions, kf, kr):
     return (lambda x: fscale(x)[0]), dyn, y_base
 
 
-def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr):
-    """fscale(x) -> (F, gross) over the dynamic indices: the residual
-    plus the per-species gross-flux scale, computed in one pass (the
-    solver's net-vs-gross convergence measure)."""
+def _dynamic_setup(spec: ModelSpec, cond: Conditions):
+    """(dyn, static, y_base) shared by every dynamic-restriction helper,
+    so the residual, its scale, and both Jacobian implementations are
+    guaranteed to describe the same reactor model."""
     dyn = jnp.asarray(spec.dynamic_indices)
     terms = _reactor_terms(spec, cond)
     static = dict(reac_idx=spec.reac_idx, prod_idx=spec.prod_idx,
                   is_gas=spec.is_gas, stoich=spec.stoich,
                   is_adsorbate=spec.is_adsorbate, **terms)
-    y_base = jnp.asarray(cond.y0)
+    return dyn, static, jnp.asarray(cond.y0)
+
+
+def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr):
+    """fscale(x) -> (F, gross) over the dynamic indices: the residual
+    plus the per-species gross-flux scale, computed in one pass (the
+    solver's net-vs-gross convergence measure)."""
+    dyn, static, y_base = _dynamic_setup(spec, cond)
 
     def fscale(x):
         y = y_base.at[dyn].set(x)
@@ -235,12 +242,7 @@ def _dynamic_jacobian(spec: ModelSpec, cond: Conditions, kf, kr):
     passes well; the closed form's gather/one-hot contractions lower
     poorly). Kept as the independent implementation backing the
     jacfwd-vs-closed-form parity tests."""
-    dyn = jnp.asarray(spec.dynamic_indices)
-    terms = _reactor_terms(spec, cond)
-    static = dict(reac_idx=spec.reac_idx, prod_idx=spec.prod_idx,
-                  is_gas=spec.is_gas, stoich=spec.stoich,
-                  is_adsorbate=spec.is_adsorbate, **terms)
-    y_base = jnp.asarray(cond.y0)
+    dyn, static, y_base = _dynamic_setup(spec, cond)
 
     def jac(x):
         y = y_base.at[dyn].set(x)

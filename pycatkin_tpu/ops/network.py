@@ -126,8 +126,9 @@ def chem_jacobian(y, kf, kr, *, reac_idx, prod_idx, is_gas, stoich):
     vectorized): d(fwd_k)/dy_i = kf_k * sum over slots holding i of the
     product of the OTHER slot factors, times d(y_eff_i)/dy_i (bar->Pa
     for gas). Repeated slots (stoichiometric powers y^c) sum to the
-    correct c * y^(c-1) * rest. One scatter-add builds the [n_r, n_s]
-    rate Jacobian; the species Jacobian is a single matmul. Agreement
+    correct c * y^(c-1) * rest. Dense one-hot contractions build the
+    [n_r, n_s] rate Jacobian (see the inline comment on why not
+    scatter-add); the species Jacobian is a single matmul. Agreement
     with ``jax.jacfwd`` of the RHS is pinned by
     tests/test_analytic_jacobian.py (the autodiff path is what the
     solvers use -- it measures faster on TPU)."""
@@ -162,5 +163,4 @@ def reactor_jacobian(y, t, kf, kr, *, reac_idx, prod_idx, is_gas, stoich,
         return J * is_adsorbate[:, None]
     row_scale = jnp.where(is_adsorbate > 0, 1.0, sigma_over_bar)
     J = J * row_scale[:, None]
-    return J - jnp.diag(jnp.where(is_gas > 0, inv_tau, 0.0) *
-                        jnp.ones_like(y))
+    return J - jnp.diag(jnp.where(is_gas > 0, inv_tau, 0.0))

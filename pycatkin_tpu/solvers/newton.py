@@ -234,8 +234,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
                            opts.floor)
         F_new, gross_new = fscale_fn(x_new)
-        fnorm_new = jnp.max(jnp.abs(F_new) /
-                            (opts.rate_tol + opts.rate_tol_rel * gross_new))
+        fnorm_new = _rnorm(F_new, gross_new, opts)
         finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
         accept = finite & (fnorm_new < fnorm)
         lam_new = jnp.where(accept, jnp.maximum(lam / 3.0, 1e-12),
@@ -247,7 +246,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
                 lam_new, k + 1)
 
     F0, gross0 = fscale_fn(x0)
-    f0 = jnp.max(jnp.abs(F0) / (opts.rate_tol + opts.rate_tol_rel * gross0))
+    f0 = _rnorm(F0, gross0, opts)
     x, F, gross, fnorm, lam, k = jax.lax.while_loop(
         cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-3, x0.dtype), 0))
     return x, fnorm, k

@@ -1,0 +1,85 @@
+"""run_parameters sweeps (reference presets.py:170-305): any params key,
+including inflow_state_X entries, solved as one batched program."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.api import presets
+from tests.conftest import reference_path
+
+
+def test_pressure_sweep_dmtm(ref_root, tmp_path):
+    """Pressure sweep on DMTM: steady coverages stay conserved at every
+    pressure and artifacts carry the swept values."""
+    sim = pk.read_from_input_file(
+        reference_path("examples", "DMTM", "input.json"))
+    pressures = [5.0e4, 1.0e5, 2.0e5]
+    finals, rates, drcs = presets.run_parameters(
+        sim_system=sim, parameters=pressures, params_name="pressure",
+        steady_state_solve=True, save_results=True,
+        csv_path=str(tmp_path))
+    assert finals.shape[0] == 3
+    ads = sim.adsorbate_indices
+    for row in finals:
+        assert abs(np.sum(row[ads]) - 1.0) <= 1e-6
+    df = pd.read_csv(tmp_path / "coverages_vs_pressure.csv")
+    assert len(df) == 3
+    assert np.allclose(df.iloc[:, 0].values, pressures)
+
+
+def test_inflow_sweep_cstr(ref_root, tmp_path):
+    """Inflow CO partial-pressure sweep on the COOx CSTR: more CO in the
+    feed, more CO out; conversion stays finite and physical."""
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxReactor", "input_Pd111.json"))
+    sim.params["temperature"] = 523.0
+    feeds = [0.01, 0.02, 0.04]
+    finals, rates, drcs = presets.run_parameters(
+        sim_system=sim, parameters=feeds,
+        params_name="inflow_state_CO", steady_state_solve=True,
+        save_results=True, csv_path=str(tmp_path))
+    iCO = sim.snames.index("CO")
+    pCO_out = finals[:, iCO]
+    assert np.all(np.diff(pCO_out) > 0), "outlet CO must rise with feed"
+    conv = 100.0 * (1.0 - pCO_out / np.asarray(feeds))
+    assert np.all((conv > 0) & (conv < 100))
+    assert os.path.isfile(tmp_path / "pressures_vs_inflow_state_CO.csv")
+
+
+def test_save_pes_energies_and_landscape_figures(ref_root, tmp_path):
+    """save_pes_energies (reference presets.py:474-498) and
+    draw_energy_landscapes produce the reference-named artifacts; the
+    relative landscape starts at zero."""
+    import matplotlib
+    matplotlib.use("Agg")
+
+    from pycatkin_tpu.api.plotting import draw_energy_landscapes
+
+    sim = pk.read_from_input_file(
+        reference_path("examples", "DMTM", "input.json"))
+    presets.save_pes_energies(sim_system=sim, csv_path=str(tmp_path))
+    files = [f for f in os.listdir(tmp_path) if "energy_landscape" in f]
+    assert files, "no landscape CSVs written"
+    df = pd.read_csv(tmp_path / files[0])
+    assert df["Free (eV)"][0] == pytest.approx(0.0)
+    assert df["Electronic (eV)"][0] == pytest.approx(0.0)
+
+    draw_energy_landscapes(sim_system=sim, fig_path=str(tmp_path) + "/")
+    assert any(f.endswith(".png") for f in os.listdir(tmp_path))
+
+
+def test_get_tof_for_given_reactions(ref_root):
+    """TOF of named steps at the transient tail (reference
+    presets.py:585-597): r5 + r9 both produce methanol at the DMTM
+    steady state, and each contributes non-negatively."""
+    sim = pk.read_from_input_file(
+        reference_path("examples", "DMTM", "input.json"))
+    sim.solve_odes()
+    tof_both = presets.get_tof_for_given_reactions(sim, ["r5", "r9"])
+    tof_r9 = presets.get_tof_for_given_reactions(sim, ["r9"])
+    assert tof_both > 0
+    assert 0 <= tof_r9 <= tof_both * (1 + 1e-9)
