@@ -91,21 +91,43 @@ def _scipy_residual(sim, cond=None):
 # config 1: CH4 steady state
 def config_1():
     """CH4 MK steady state (68 scaling states / 58 reactions): one warm
-    jitted PTC-Newton solve vs scipy.optimize.root(method='lm') on the
-    identical residual (the reference's find_steady strategy,
-    system.py:599)."""
+    jitted Newton solve vs scipy.optimize.root('lm') on the identical
+    residual from the identical start state, both judged against the
+    PHYSICAL root.
+
+    The CH4 network is multistable (several individually-stable roots);
+    the physically meaningful one is the t->inf limit of the reference
+    start state (the reference's own find_steady always seeds from the
+    transient tail, old_system.py:393-395). An untimed CPU-side
+    integration to t=1e12 s + Newton polish establishes that root
+    (y_star) once; the timed solvers then run from the plain start
+    state. Round-3 finding behind round 2's same_root:false: the device
+    PTC lands ON the physical root even unseeded (also pinned by
+    tests/test_ch4.py::test_steady_root_is_physical), while scipy lm
+    converges to a different stable-but-unreached branch -- and when
+    seeded AT the exact root it diverges to the all-empty pseudo-root
+    (FD Jacobian + conservation null space + 1e-32 floors), measured
+    status=5 maxfev. The keys report each side's verdict explicitly."""
     import jax
+    import jax.numpy as jnp
 
     import pycatkin_tpu as pk
     from pycatkin_tpu import engine
+    from pycatkin_tpu.solvers.ode import log_time_grid
 
     sim = pk.read_from_input_file(ref("test", "CH4_input.json"))
     spec, cond = sim.spec, sim.conditions()
-    solve = jax.jit(lambda c: engine.steady_state(spec, c))
+    dyn = np.asarray(spec.dynamic_indices)
 
-    # Warm up at a shifted temperature: repeated bit-identical executions
-    # can be served from infrastructure-level caches, so every timed run
-    # here uses input values the device has not seen.
+    # Timed device solve FIRST, in pristine process state: long mixed
+    # CPU/subprocess phases beforehand degrade per-kernel dispatch
+    # latency on the tunneled TPU runtime ~100x for this small-op
+    # program (measured: identical jitted solve, same 43 iterations,
+    # 0.2 ms early in the process vs 51 ms after the seeding phase).
+    solve = jax.jit(lambda c: engine.steady_state(spec, c))
+    # Warm up at a shifted temperature: repeated bit-identical
+    # executions can be served from infrastructure-level caches, so
+    # every timed run here uses input values the device has not seen.
     jax.block_until_ready(solve(cond._replace(T=cond.T + 0.5)).x)
     reps = 10
     t0 = time.perf_counter()
@@ -114,16 +136,73 @@ def config_1():
     jax.block_until_ready(out.x)
     tpu_s = (time.perf_counter() - t0) / reps
     ok = bool(out.success)
+    x_dev = np.asarray(out.x)[dyn]
     log(f"[1] device steady solve: {tpu_s*1e3:.2f} ms, success={ok}, "
+        f"iters={int(out.iterations)}, attempts={int(out.attempts)}, "
         f"residual={float(out.residual):.3e}")
 
-    # scipy baseline: lm root on a pure-numpy residual, with the
-    # reference's retry strategy (system.py:566-639: re-normalize,
-    # random restarts) and its physicality verdict (theta >= 0, site
-    # sums ~ 1) -- plain lm happily converges to unphysical roots.
+    # Shared seeding step (untimed for either side): integrate the
+    # reference time span from the reference start state. Runs on the
+    # HOST CPU backend in a SUBPROCESS: the CH4 network's stiff tail
+    # makes individual TR-BDF2 chunk kernels run for minutes, which
+    # trips the shared TPU runtime's execution watchdog (measured: TPU
+    # worker crash).
+    import subprocess
+    import tempfile
+
+    times = sim.params["times"]
+    tail_path = os.path.join(tempfile.gettempdir(), "pycatkin_ch4_tail.npz")
+    here = os.path.dirname(os.path.abspath(__file__))
+    seed_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PALLAS_AXON_POOL_IPS="",
+                    PYTHONPATH=here + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""))
+    seed_code = f"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.solvers.ode import log_time_grid
+sim = pk.read_from_input_file({ref("test", "CH4_input.json")!r})
+spec, cond = sim.spec, sim.conditions()
+grid = np.asarray(log_time_grid({times[0]!r}, {times[-1]!r}, 40))
+ys, ok = engine.transient_chunked(spec, cond, grid)
+np.savez({tail_path!r}, tail=np.asarray(ys[-1]), ok=bool(ok))
+"""
+    t0 = time.perf_counter()
+    subprocess.run([sys.executable, "-c", seed_code], env=seed_env,
+                   cwd=here, check=True)
+    seed_s = time.perf_counter() - t0
+    seed = np.load(tail_path)
+    y_inf, t_ok = seed["tail"], bool(seed["ok"])
+    # Newton-land the tail on its root: the integrator's phantom-root
+    # projection (ODEOptions.clamp_lo) can leave a ~1e-6 offset on a
+    # hard tail. Basin identity is guarded by the tiny polish distance;
+    # y_star is then the physical (t->inf) root all roots are judged
+    # against, and the common seed for both timed solvers.
+    pol = engine.steady_state(spec, cond, x0=jnp.asarray(y_inf[dyn]))
+    d_pol = float(np.max(np.abs(np.asarray(pol.x) - y_inf)))
+    assert bool(pol.success) and d_pol < 1e-4, \
+        f"transient tail not on a root (moved {d_pol:.2e})"
+    y_star = np.asarray(pol.x)
+    log(f"[1] seeding transient to t={times[-1]:.0e}: {seed_s:.1f} s "
+        f"(ok={bool(t_ok)}, polish moved {d_pol:.2e})")
+
+    # Root identity vs the physical root: solver-precision differences
+    # are ~1e-6 (each solve stops at its residual tolerance);
+    # inter-root separations on this network are orders larger.
+    d_phys = float(np.max(np.abs(x_dev - y_star[dyn])))
+    physical_root = d_phys < 1e-4
+    log(f"[1] device root vs physical root: |x-y_star|={d_phys:.2e}")
+
+    # scipy baseline: lm root from the same start state, with the
+    # reference's retry strategy (system.py:566-639: random restarts)
+    # and its physicality verdict (theta >= 0, site sums ~ 1) as the
+    # fallback ladder.
     from scipy.optimize import root
     fun, x0 = _scipy_residual(sim, cond)
-    groups = spec.groups[:, np.asarray(spec.dynamic_indices)]
+    groups = spec.groups[:, dyn]
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()
     x_sci, n_tries = None, 0
@@ -139,31 +218,31 @@ def config_1():
         x0 = rng.uniform(0.0, 1.0, size=x0.shape)
         x0 = x0 / (groups.T @ (groups @ x0))
     scipy_s = time.perf_counter() - t0
-    x_dev = np.asarray(out.x)[np.asarray(spec.dynamic_indices)]
     dsol = (float(np.max(np.abs(x_dev - x_sci)))
             if x_sci is not None else None)
-    # A large delta with a converged scipy run means lm found a DIFFERENT
-    # physical root (the mechanism is multistable, cf. the COOx CSTR's
-    # documented CO-poisoned branch). Judge both candidate roots with the
-    # framework's own residual + Jacobian-eigenvalue stability verdict.
-    same_root = dsol is not None and dsol < 1e-6
+    same_root = dsol is not None and dsol < 1e-4
+    scipy_physical = (x_sci is not None
+                      and float(np.max(np.abs(x_sci - y_star[dyn]))) < 1e-4)
     our_root_stable = bool(np.asarray(
         engine.check_stability(spec, cond, np.asarray(out.x))))
     alt_root_stable = None
     if x_sci is not None and not same_root:
         y_sci = np.asarray(cond.y0).copy()
-        y_sci[np.asarray(spec.dynamic_indices)] = x_sci
+        y_sci[dyn] = x_sci
         alt_root_stable = bool(np.asarray(
             engine.check_stability(spec, cond, y_sci)))
     log(f"[1] scipy lm root: {scipy_s*1e3:.1f} ms ({n_tries} tries), "
-        f"physical={x_sci is not None}, same_root={same_root}, "
+        f"physical={scipy_physical}, same_root={same_root}, "
         f"stable(ours/alt)={our_root_stable}/{alt_root_stable}")
 
     return {"config": 1, "metric": "CH4 steady-state solve", "ok": ok,
             "value": round(tpu_s * 1e3, 3), "unit": "ms",
             "vs_baseline": round(scipy_s / tpu_s, 2),
+            "seed": "transient",
             "baseline_physical": x_sci is not None,
             "same_root": same_root,
+            "physical_root": physical_root,
+            "scipy_physical_root": scipy_physical,
             "our_root_stable": our_root_stable,
             "alt_root_stable": alt_root_stable}
 
@@ -314,10 +393,18 @@ def config_5():
     from pycatkin_tpu.parallel.batch import (broadcast_conditions,
                                              sweep_steady_state)
 
+    from pycatkin_tpu.solvers.newton import SolverOptions
+
     sim = synthetic_system(n_species=200, n_reactions=500, seed=0)
     spec = sim.spec
     n_dyn = len(spec.dynamic_indices)
     assert n_dyn > 48, f"LU path not exercised (n_dyn={n_dyn})"
+    # Aggressive PTC pacing for LARGE per-lane systems: at n_dyn=190
+    # every iteration pays a full Jacobian + LU, so dt-ramp iterations
+    # are the cost center (2.3x wall vs the defaults; measured matrix in
+    # docs/perf_config5.md). The conservative defaults stay global --
+    # they win on the small-network volcano/sweep configs.
+    opts = SolverOptions(dt0=1.0e-3, dt_grow_min=6.0)
 
     Ts = np.linspace(420.0, 700.0, 8)
     ps = np.logspace(4.0, 6.0, 4)
@@ -333,11 +420,11 @@ def config_5():
 
     t0 = time.perf_counter()
     warm = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
-                              tof_mask=mask)
+                              tof_mask=mask, opts=opts)
     jax.block_until_ready(warm["y"])
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts)
     jax.block_until_ready(out["y"])
     tpu_s = time.perf_counter() - t0
     n_ok = int(np.sum(np.asarray(out["success"])))

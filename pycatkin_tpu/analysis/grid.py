@@ -50,6 +50,63 @@ def classify_failures(spec, results, coverage_tol: float = 5.0e-2):
     return labels, detail
 
 
+def replay_lane(spec, conds, lane: int, x0=None,
+                opts=None, strategies=("ptc", "lm"), verbose: bool = True):
+    """Re-solve ONE lane of a batched sweep with full diagnostics -- the
+    debugging half of the reference's ``check_convergence``, which
+    re-solves each failed grid point on a rebuilt system to classify it
+    (analysis.py:27-76). The batched path classifies from stored
+    diagnostics; this helper is for interrogating a stubborn point:
+    it runs each strategy in sequence from the given (or stored) guess,
+    prints residual/iterations/attempts and the per-group coverage sums
+    per strategy, and returns the best result.
+
+    conds: the lane-batched Conditions of the sweep; lane: index into
+    it. x0: optional [n_dyn] initial guess (e.g. the failed iterate from
+    ``results.x``). Returns (SteadyStateResults, report dict).
+    """
+    import jax
+
+    from .. import engine
+    from ..solvers.newton import SolverOptions
+
+    opts = opts or SolverOptions()
+    cond = jax.tree_util.tree_map(lambda a: np.asarray(a)[lane], conds)
+    groups = np.asarray(spec.groups)
+    best, report = None, {"lane": int(lane), "tries": []}
+    for strategy in strategies:
+        res = engine.steady_state(spec, cond, x0=x0, opts=opts,
+                                  strategy=strategy)
+        y = np.asarray(res.x)
+        entry = {
+            "strategy": strategy,
+            "success": bool(res.success),
+            "residual": float(res.residual),
+            "iterations": int(res.iterations),
+            "attempts": int(res.attempts),
+            "group_sums": (groups @ y).tolist(),
+            "min_coverage": float(np.min(y[spec.dynamic_indices])),
+            "stable": bool(engine.check_stability(spec, cond, y))
+            if bool(res.success) else None,
+        }
+        report["tries"].append(entry)
+        if verbose:
+            print(f"replay lane {lane} [{strategy}]: "
+                  f"success={entry['success']} "
+                  f"residual={entry['residual']:.3e} "
+                  f"iters={entry['iterations']} "
+                  f"attempts={entry['attempts']} "
+                  f"sums={np.round(entry['group_sums'], 6)} "
+                  f"min_theta={entry['min_coverage']:.2e} "
+                  f"stable={entry['stable']}")
+        if best is None or (bool(res.success) and not bool(best.success)):
+            best = res
+        if bool(res.success):
+            break
+        x0 = np.asarray(res.x)[spec.dynamic_indices]  # chain strategies
+    return best, report
+
+
 def average_neighborhood(values: np.ndarray, success: np.ndarray):
     """Patch every failed grid point with the mean of its converged
     8-neighborhood (reference analysis.py:79-116, fixed to repair ALL

@@ -48,16 +48,19 @@ def _net_rates_program(spec):
 
 @lru_cache(maxsize=128)
 def _drc_program(spec, tof_terms, drc_mode, eps, sopts):
+    """Batched DRC returning (xi [lanes, n_r], ok [lanes]): ok=False
+    lanes had an unconverged (perturbed) solve and carry unreliable xi."""
     if drc_mode == "fd":
         # opts deliberately not forwarded: drc_fd's default tightened
         # tolerances are required for a meaningful difference quotient.
         def drc_one(cond, x0):
             return engine.drc_fd(spec, cond, list(tof_terms), eps=eps,
-                                 x0=x0)
+                                 x0=x0, return_success=True)
     else:
         def drc_one(cond, x0):
-            return engine.drc(spec, cond, list(tof_terms), x0=x0,
-                              opts=sopts)
+            xi = engine.drc(spec, cond, list(tof_terms), x0=x0,
+                            opts=sopts)
+            return xi, jnp.asarray(True)
     return jax.jit(jax.vmap(drc_one))
 
 
@@ -116,8 +119,15 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
     if tof_terms is not None:
         x0s = jnp.asarray(finals[:, spec.dynamic_indices])
         sopts = sim_system.solver_options()
-        xis = np.asarray(_drc_program(spec, tuple(tof_terms), drc_mode,
-                                      float(eps), sopts)(batched, x0s))
+        xis, drc_ok = _drc_program(spec, tuple(tof_terms), drc_mode,
+                                   float(eps), sopts)(batched, x0s)
+        xis = np.asarray(xis)
+        drc_ok = np.asarray(drc_ok)
+        if not drc_ok.all():
+            bad = [values[i] for i in np.flatnonzero(~drc_ok)]
+            print(f"Warning: DRC perturbed steady solves unconverged for "
+                  f"sweep values {bad}; xi for those lanes is unreliable "
+                  "(prefer drc_mode='implicit')", file=sys.stderr)
         for i, v in enumerate(values):
             drcs[v] = dict(zip(spec.rnames, xis[i]))
     return finals, rates, drcs
@@ -364,12 +374,15 @@ def write_results(sim_system, path=""):
         os.path.join(path, f"pressures_{tag}.csv"), index=False)
 
 
-def save_structures(sim_system, fig_path="", types_to_skip=("TS",)):
-    """Export every state's structure as .pdb (the file-artifact half of
-    the reference's draw_states preset, presets.py:308-320 +
-    cooxreactor.py:22-25; the interactive ASE viewer itself has no
-    headless counterpart and is out of scope). Returns {name: path} for
-    the states that had structure data."""
+def save_structures(sim_system, fig_path="", types_to_skip=("TS",),
+                    render_png=True):
+    """Export every state's structure as .pdb plus a headless .png
+    render (the file-artifact side of the reference's draw_states
+    preset, presets.py:308-320 + state.py:444-463 view_atoms image
+    export; the interactive ASE viewer itself has no headless
+    counterpart and is out of scope). Returns {name: pdb_path} for the
+    states that had structure data; .png renders land next to the
+    .pdb files."""
     written = {}
     for name, st in sim_system.states.items():
         if st.state_type in types_to_skip:
@@ -377,6 +390,8 @@ def save_structures(sim_system, fig_path="", types_to_skip=("TS",)):
         fname = st.save_pdb(path=fig_path)
         if fname:
             written[name] = fname
+            if render_png:
+                st.save_png(path=fig_path)
     return written
 
 

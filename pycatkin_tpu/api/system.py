@@ -45,6 +45,26 @@ class System:
                 f"desorption_model must be 'detailed_balance' or "
                 f"'collision', got {desorption_model!r}")
         self.desorption_model = desorption_model
+        # Legacy solver knobs are honored, not silently swallowed
+        # (reference old_system.py:154-174):
+        #   ode_solver -- 'trbdf2' is the native integrator; the
+        #     reference schema values 'solve_ivp' and 'ode' (scipy BDF /
+        #     lsoda, old_system.py:350-376) are accepted as aliases of
+        #     it (same stiff integrate-to-steady capability); anything
+        #     else raises.
+        #   nsteps -> ODEOptions.max_steps (per-save-interval budget).
+        #   ftol/xtol -> SolverOptions.rate_tol: the reference passes
+        #     both to least_squares (old_system.py:426-428), which stops
+        #     when EITHER fires; convergence here is purely
+        #     residual-based, so the tightest of the two becomes the
+        #     absolute residual tolerance (reference inputs ship
+        #     non-default xtol, e.g. COOxReactor's 1e-12).
+        if ode_solver not in ("trbdf2", "solve_ivp", "ode"):
+            raise ValueError(
+                f"ode_solver={ode_solver!r} is not supported: use "
+                "'trbdf2' (the native TR-BDF2 stiff integrator) or the "
+                "reference-schema aliases 'solve_ivp'/'ode', which map "
+                "onto it.")
         # Legacy-compatible parameter dict (reference old_system.py:154-174);
         # sweep drivers mutate these keys directly.
         self.params = {
@@ -244,10 +264,19 @@ class System:
     # ------------------------------------------------------------------
     # solvers
     def _ode_options(self) -> ODEOptions:
-        return ODEOptions(rtol=self.params["rtol"], atol=self.params["atol"])
+        opts = ODEOptions(rtol=self.params["rtol"],
+                          atol=self.params["atol"])
+        # The legacy default (1e4) maps onto the native default budget;
+        # an explicitly tuned nsteps becomes the per-interval step cap.
+        if int(self.params["nsteps"]) != 10000:
+            opts = opts._replace(max_steps=int(self.params["nsteps"]))
+        return opts
 
     def solver_options(self, **overrides) -> SolverOptions:
-        base = SolverOptions(floor=self.min_tol)
+        # ftol/xtol: tightest wins (see __init__ knob mapping notes).
+        base = SolverOptions(floor=self.min_tol,
+                             rate_tol=min(float(self.params["ftol"]),
+                                          float(self.params["xtol"])))
         return base._replace(**overrides) if overrides else base
 
     def solve_odes(self, n_out=None, times=None):
@@ -289,8 +318,18 @@ class System:
         x0 = None
         if y0 is not None:
             x0 = np.asarray(y0)[self.spec.dynamic_indices]
-        elif use_transient_guess and self.solution is not None:
-            x0 = self.solution[-1][self.spec.dynamic_indices]
+        elif use_transient_guess:
+            if self.solution is None and self.params.get("times"):
+                # Multistable networks (e.g. the CH4 oxidation mechanism)
+                # carry several stable roots; the physically meaningful
+                # one is the t->inf limit of the start state. The
+                # reference ALWAYS seeds find_steady from the transient
+                # tail (old_system.py:393-395, and every preset runs
+                # solve_odes first) -- so when no transient is stored and
+                # a time span is configured, integrate before solving.
+                self.solve_odes()
+            if self.solution is not None:
+                x0 = self.solution[-1][self.spec.dynamic_indices]
         res = engine.steady_state(self.spec, cond, x0=x0, key=key,
                                   opts=solver_opts)
         if not bool(res.success):
@@ -374,6 +413,14 @@ class System:
 
     def activity(self, tof_terms, ss_solve=False):
         tof_val = self.run_and_return_tof(tof_terms, ss_solve=ss_solve)
+        if tof_val <= 0.0:
+            import warnings
+            warnings.warn(
+                f"activity: net TOF of {tof_terms} is non-positive "
+                f"({tof_val:.3e}); the selected steps run in reverse at "
+                "the solution. Reporting the activity of |TOF| (the "
+                "reference silently NaNs here, old_system.py:524-529).",
+                stacklevel=2)
         return float(engine.activity_from_tof(tof_val,
                                               self.params["temperature"]))
 

@@ -292,8 +292,14 @@ def check_stability(spec: ModelSpec, cond: Conditions, y_full,
     return newton.jacobian_eigenvalues_stable(J, pos_tol)
 
 
-def _transient_closures(spec: ModelSpec, cond: Conditions):
+def _transient_closures(spec: ModelSpec, cond: Conditions,
+                        steady_rel: float = ODEOptions().steady_rel):
     """(rhs, jac, steady_fn, relax_fn) for the transient integrator.
+
+    ``steady_rel``: the relative net-vs-gross tolerance of the relax
+    oracle -- threaded from the active ODEOptions/SolverOptions so a
+    caller who tightens the steady verdict gets transient error-test
+    waiving judged at the same level (not at the class default).
 
     Two oracles with distinct jobs. ``steady_fn`` (freeze): PURELY
     relative threshold at the f64 cancellation floor of the flux sums
@@ -311,7 +317,7 @@ def _transient_closures(spec: ModelSpec, cond: Conditions):
     rhs, rhs_and_scale = make_rhs_and_scale(spec, cond)
     jac = jax.jacfwd(rhs)
     floor = 8.0 * float(jnp.finfo(jnp.float64).eps)
-    verdict_rel = SolverOptions().rate_tol_rel
+    verdict_rel = steady_rel
 
     def steady_fn(y):
         net, gross = rhs_and_scale(y)
@@ -333,12 +339,14 @@ def transient_state(spec: ModelSpec, cond: Conditions, state, save_ts,
     multi-minute kernel trips execution watchdogs on shared TPU
     runtimes), all served by ONE compiled program when chunks share a
     shape. Returns (state, ys_chunk)."""
-    rhs, jac, steady_fn, relax_fn = _transient_closures(spec, cond)
+    rhs, jac, steady_fn, relax_fn = _transient_closures(
+        spec, cond, steady_rel=opts.steady_rel)
     return ode_integrate_state(rhs, jac, state, save_ts, opts,
                                steady_fn=steady_fn, relax_fn=relax_fn)
 
 
-def transient_finish(spec: ModelSpec, cond: Conditions, y_last, ok):
+def transient_finish(spec: ModelSpec, cond: Conditions, y_last, ok,
+                     sopts: SolverOptions = SolverOptions()):
     """Newton finish (the reference's own integrate-then-root pattern,
     old_system.py:385-434): when relaxed stepping still runs out of
     max_steps short of t_end -- h sawtooths at the stage-convergence
@@ -348,10 +356,15 @@ def transient_finish(spec: ModelSpec, cond: Conditions, y_last, ok):
     Guarded by closeness so a Newton jump to a DIFFERENT root (basin
     not actually reached) keeps the honest failure flag.
     Returns (y_final, ok)."""
-    _, _, _, relax_fn = _transient_closures(spec, cond)
+    _, _, _, relax_fn = _transient_closures(
+        spec, cond, steady_rel=sopts.rate_tol_rel)
     dyn = jnp.asarray(spec.dynamic_indices)
-    res = steady_state(spec, cond, x0=y_last[dyn])
-    near = jnp.max(jnp.abs(res.x - y_last)) <= 1.0e-2
+    res = steady_state(spec, cond, x0=y_last[dyn], opts=sopts)
+    # 5e-2: wide enough to absorb clamp-projected pseudo-state offsets
+    # (ODEOptions.clamp_lo) on top of ordinary relaxation distance,
+    # still far inside typical inter-root separations (>= 0.1 on the
+    # bistable test mechanism).
+    near = jnp.max(jnp.abs(res.x - y_last)) <= 5.0e-2
     good = res.success & relax_fn(y_last) & near
     replace = (~ok) & good
     return jnp.where(replace, res.x, y_last), ok | good
@@ -365,7 +378,8 @@ def transient(spec: ModelSpec, cond: Conditions, save_ts,
     One-shot jittable form; prefer :func:`transient_chunked` (or
     ``parallel.batch.batch_transient``) from the host for long save
     grids, which bound per-call device time."""
-    rhs, jac, steady_fn, relax_fn = _transient_closures(spec, cond)
+    rhs, jac, steady_fn, relax_fn = _transient_closures(
+        spec, cond, steady_rel=opts.steady_rel)
     ys, ok = integrate(rhs, jac, jnp.asarray(cond.y0, dtype=jnp.float64),
                        jnp.asarray(save_ts), opts, steady_fn=steady_fn,
                        relax_fn=relax_fn)
@@ -453,8 +467,16 @@ def tof(spec: ModelSpec, cond: Conditions, y, tof_mask):
 def activity_from_tof(tof_value, T):
     """Activity [eV] = ln(h*TOF/kB*T) * RT (reference
     old_system.py:517-529). Log-assembled: h*TOF underflows TPU's
-    f32-ranged f64 emulation for small TOF."""
-    log_term = jnp.log(tof_value) + LOG_H_OVER_KB - jnp.log(T)
+    f32-ranged f64 emulation for small TOF.
+
+    Non-positive TOF guard: a negative net TOF (the selected steps run
+    in REVERSE at the solution) would NaN the log -- the reference does
+    exactly that, silently (old_system.py:524-529 takes np.log of a
+    negative). Here the MAGNITUDE enters the log, reporting the activity
+    of the reverse-running process; callers that can warn host-side
+    (System.activity, sweep_steady_state) surface the sign so it is not
+    silently lost. An exactly-zero TOF yields -inf (no turnover)."""
+    log_term = jnp.log(jnp.abs(tof_value)) + LOG_H_OVER_KB - jnp.log(T)
     return (log_term * (R * T)) * 1.0e-3 / eVtokJ
 
 

@@ -38,6 +38,23 @@ STATE_TYPES = (GAS, ADSORBATE, SURFACE, TS)
 # when detecting linear molecules (reference state.py:69,99).
 INERTIA_CUTOFF = 1.0e-12
 
+# CPK/jmol-ish element colors + covalent-radius-ish sizes for the
+# headless structure render (State.save_png). Unlisted elements fall
+# back to gray / 1.2 A.
+ELEMENT_COLORS = {
+    "H": "#f2f2f2", "C": "#555555", "N": "#3050f8", "O": "#ff0d0d",
+    "F": "#90e050", "Al": "#bfa6a6", "Si": "#f0c8a0", "P": "#ff8000",
+    "S": "#ffff30", "Cl": "#1ff01f", "Ti": "#bfc2c7", "Fe": "#e06633",
+    "Co": "#f090a0", "Ni": "#50d050", "Cu": "#c88033", "Zn": "#7d80b0",
+    "Pd": "#006985", "Ag": "#c0c0c0", "Pt": "#d0d0e0", "Au": "#ffd123",
+}
+ELEMENT_RADII = {
+    "H": 0.4, "C": 0.75, "N": 0.72, "O": 0.7, "F": 0.6, "Al": 1.2,
+    "Si": 1.1, "P": 1.05, "S": 1.0, "Cl": 1.0, "Ti": 1.5, "Fe": 1.35,
+    "Co": 1.3, "Ni": 1.25, "Cu": 1.3, "Zn": 1.25, "Pd": 1.4, "Ag": 1.45,
+    "Pt": 1.4, "Au": 1.4,
+}
+
 
 @dataclass
 class State:
@@ -244,6 +261,44 @@ class State:
                     f"{x:8.3f}{y:8.3f}{z:8.3f}{1.0:6.2f}{0.0:6.2f}"
                     f"          {sym:>2s}\n")
             fh.write("END\n")
+        return fname
+
+    def save_png(self, path: str = ""):
+        """Headless .png render of the state's structure (parity with
+        the reference's ``view_atoms`` image export, state.py:444-463,
+        which writes .png through ASE's renderer; the interactive viewer
+        has no headless counterpart). Matplotlib 3D scatter with CPK-ish
+        element colors, atoms depth-sorted and sized by covalent radius.
+        Returns the file path, or None when no structure is available."""
+        struct = self.get_structure()
+        if struct is None:
+            return None
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        symbols, positions = struct
+        pos = np.asarray(positions, dtype=float)
+        colors = [ELEMENT_COLORS.get(s, "#909090") for s in symbols]
+        radii = np.array([ELEMENT_RADII.get(s, 1.2) for s in symbols])
+        fig = plt.figure(figsize=(4.5, 4.5))
+        ax = fig.add_subplot(projection="3d")
+        ax.scatter(pos[:, 0], pos[:, 1], pos[:, 2], c=colors,
+                   s=(radii * 18.0) ** 2, edgecolors="black",
+                   linewidths=0.4, depthshade=True)
+        # Equal aspect so slabs don't look sheared.
+        spans = pos.max(axis=0) - pos.min(axis=0)
+        mids = (pos.max(axis=0) + pos.min(axis=0)) / 2.0
+        half = max(float(spans.max()) / 2.0, 1.0)
+        ax.set_xlim(mids[0] - half, mids[0] + half)
+        ax.set_ylim(mids[1] - half, mids[1] + half)
+        ax.set_zlim(mids[2] - half, mids[2] + half)
+        ax.set_axis_off()
+        ax.set_title(self.name)
+        if path:
+            os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, f"{self.name}.png")
+        fig.savefig(fname, dpi=120, bbox_inches="tight")
+        plt.close(fig)
         return fname
 
     @property

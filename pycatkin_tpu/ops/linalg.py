@@ -21,57 +21,211 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def lu_factor(A: jnp.ndarray):
+# Sequential steps per device dispatch in the very-large-n LU kernels.
+# Unrolling LU_UNROLL row/column steps inside each fori body keeps the
+# sequential-kernel count bounded (tail steps masked out with `where`).
+LU_UNROLL = 32
+
+# Width of the statically-unrolled panels in the blocked factorization.
+# NOTE (round-3 measurement): the fully-static blocked kernel is
+# numerically exact but its unrolled HLO (one-hot pivot matmuls +
+# per-panel concats under f64 emulation) blows TPU compile time past
+# 10 minutes at n=190, so it is NOT wired into the default dispatch --
+# the chunk-unrolled sequential kernels below compile in seconds and
+# run within ~1.2x of it. Kept for CPU use and as the reference
+# implementation for a future Pallas panel kernel.
+LU_BLOCK = 48
+
+
+def _lu_step(A, perm, k, idx):
+    """One partial-pivoted column elimination step. ``k`` may be traced
+    (dynamic row/column indexing lowers to dynamic slices); callers must
+    mask out steps with k >= n-1."""
+    col = jnp.abs(A[:, k])
+    col = jnp.where(idx < k, -jnp.inf, col)
+    p = jnp.argmax(col)
+    # Swap rows k and p (and the permutation entries).
+    rk, rp = A[k], A[p]
+    A = A.at[k].set(rp).at[p].set(rk)
+    pk, pp = perm[k], perm[p]
+    perm = perm.at[k].set(pp).at[p].set(pk)
+    # Eliminate below the pivot; store multipliers in column k.
+    pivot = A[k, k]
+    factors = jnp.where(idx > k, A[:, k] / pivot, jnp.zeros_like(pivot))
+    # Update only columns >= k: columns < k hold already-stored L
+    # multipliers and must not be touched by the elimination.
+    upd = jnp.where(idx >= k, A[k], 0.0)
+    A = A - factors[:, None] * upd[None, :]
+    A = A.at[:, k].set(jnp.where(idx > k, factors, A[:, k]))
+    return A, perm
+
+
+def _unit_lower_solve(L, B, strict=True):
+    """Solve L y = B for unit-lower-triangular L ([b, b] static, small)
+    by fully unrolled forward substitution. ``strict``: L's strictly
+    lower part is read, the diagonal is taken as 1."""
+    b = L.shape[-1]
+    y = B
+    for r in range(1, b):
+        y = y.at[r].add(-(L[r, :r] @ y[:r]))
+    return y
+
+
+def lu_factor_blocked(A: jnp.ndarray, block: int = LU_BLOCK):
+    """Right-looking blocked LU with partial pivoting, statically
+    unrolled (no sequential device loops).
+
+    The round-3 profile of bench config 5 (128 lanes x n=190, TPU v5e)
+    showed the column-at-a-time lu_factor at ~132-155 ms: every one of
+    its ~190 sequential steps rewrites the FULL [n, n] tile (~n^3 total
+    element writes) through tiny non-MXU kernels. Here elimination
+    writes stay inside a [n, block] panel (n^2*block total) and the
+    trailing update collapses into one matmul per panel that XLA puts on
+    the MXU, with the whole schedule unrolled at trace time. Pivot row
+    exchanges use one-hot arithmetic inside the panel; the accumulated
+    panel permutation is applied to the left/trailing blocks by a
+    one-hot permutation matmul (MXU) once per panel.
+
+    Returns (LU, perm) in the same convention as :func:`lu_factor`.
+    """
+    n = A.shape[-1]
+    idx = jnp.arange(n)
+    perm = jnp.arange(n)
+    dtype = A.dtype
+    for k0 in range(0, n, block):
+        b = min(block, n - k0)
+        P_blk = A[:, k0:k0 + b]                      # [n, b] static slice
+        pvec = jnp.arange(n)
+        carange = jnp.arange(b)
+        for c in range(b):                            # static column steps
+            j = k0 + c
+            col = jnp.abs(P_blk[:, c])
+            col = jnp.where(idx < j, -jnp.inf, col)
+            p = jnp.argmax(col)
+            oh = (idx == p).astype(dtype)
+            # Swap rows j <-> p of the panel (one-hot arithmetic) and of
+            # the permutation vector.
+            row_j = P_blk[j]
+            row_p = oh @ P_blk
+            P_blk = P_blk.at[j].set(row_p)
+            P_blk = P_blk - oh[:, None] * (row_p - row_j)[None, :]
+            pj, pp = pvec[j], pvec[p]
+            pvec = pvec.at[j].set(pp).at[p].set(pj)
+            # Eliminate below the pivot, panel columns only.
+            pivot = P_blk[j, c]
+            factors = jnp.where(idx > j, P_blk[:, c] / pivot,
+                                jnp.zeros_like(pivot))
+            upd = jnp.where(carange > c, P_blk[j], 0.0)
+            P_blk = P_blk - factors[:, None] * upd[None, :]
+            P_blk = P_blk.at[:, c].set(jnp.where(idx > j, factors,
+                                                 P_blk[:, c]))
+        # Net panel permutation as a one-hot matrix: row i of the
+        # permuted block is old row pvec[i].
+        P_mat = (pvec[:, None] == idx[None, :]).astype(dtype)
+        parts = []
+        if k0 > 0:
+            parts.append(P_mat @ A[:, :k0])           # swap stored L rows
+        parts.append(P_blk)
+        if k0 + b < n:
+            trail = P_mat @ A[:, k0 + b:]
+            L11 = jnp.tril(P_blk[k0:k0 + b, :], -1)
+            U12 = _unit_lower_solve(L11, trail[k0:k0 + b])
+            L21 = P_blk[k0 + b:, :]
+            T22 = trail[k0 + b:] - L21 @ U12
+            parts.append(jnp.concatenate([trail[:k0], U12, T22], axis=0))
+        A = jnp.concatenate(parts, axis=1)
+        perm = perm[pvec]
+    return A, perm
+
+
+def lu_factor(A: jnp.ndarray, unroll: int = LU_UNROLL):
     """LU factorization with partial pivoting.
 
     Returns (LU, perm): LU holds L (unit diagonal, below) and U (on and
     above the diagonal); perm is the row permutation applied to A.
+    ``unroll`` column steps run inside each sequential loop iteration.
     """
     n = A.shape[-1]
     idx = jnp.arange(n)
+    steps = n - 1
+    n_outer = max(-(-steps // unroll), 0)
 
-    def body(k, state):
+    def outer(o, state):
         A, perm = state
-        col = jnp.abs(A[:, k])
-        col = jnp.where(idx < k, -jnp.inf, col)
-        p = jnp.argmax(col)
-        # Swap rows k and p (and the permutation entries).
-        rk, rp = A[k], A[p]
-        A = A.at[k].set(rp).at[p].set(rk)
-        pk, pp = perm[k], perm[p]
-        perm = perm.at[k].set(pp).at[p].set(pk)
-        # Eliminate below the pivot; store multipliers in column k.
-        pivot = A[k, k]
-        factors = jnp.where(idx > k, A[:, k] / pivot, jnp.zeros_like(pivot))
-        # Update only columns >= k: columns < k hold already-stored L
-        # multipliers and must not be touched by the elimination.
-        upd = jnp.where(idx >= k, A[k], 0.0)
-        A = A - factors[:, None] * upd[None, :]
-        A = A.at[:, k].set(jnp.where(idx > k, factors, A[:, k]))
+        for d in range(unroll):
+            k = o * unroll + d
+            A2, perm2 = _lu_step(A, perm, k, idx)
+            # Mask padded tail steps (k >= n-1): garbage from the
+            # clamped dynamic indices (incl. 0-pivot inf/nan) is
+            # discarded by the select.
+            valid = k < steps
+            A = jnp.where(valid, A2, A)
+            perm = jnp.where(valid, perm2, perm)
         return A, perm
 
-    LU, perm = lax.fori_loop(0, n - 1, body, (A, jnp.arange(n)))
+    LU, perm = lax.fori_loop(0, n_outer, outer, (A, jnp.arange(n)))
     return LU, perm
 
 
-def lu_solve(LU: jnp.ndarray, perm: jnp.ndarray, b: jnp.ndarray):
-    """Solve A x = b given lu_factor output. b: [n] or [n, k]."""
+def lu_solve_blocked(LU: jnp.ndarray, perm: jnp.ndarray, b: jnp.ndarray,
+                     block: int = LU_BLOCK):
+    """Blocked triangular solves for lu_factor output, statically
+    unrolled: within-block substitution + one cross-block matmul per
+    block (the sequential row recurrence only ever spans ``block``
+    rows). b: [n] or [n, k]."""
+    n = LU.shape[-1]
+    vec = b.ndim == 1
+    y = (b[perm, None] if vec else b[perm]).astype(LU.dtype)
+    # Forward: unit-lower L.
+    for k0 in range(0, n, block):
+        bb = min(block, n - k0)
+        rhs = y[k0:k0 + bb] - LU[k0:k0 + bb, :k0] @ y[:k0]
+        blkL = jnp.tril(LU[k0:k0 + bb, k0:k0 + bb], -1)
+        y = y.at[k0:k0 + bb].set(_unit_lower_solve(blkL, rhs))
+    # Backward: upper U with diagonal.
+    x = y
+    for k0 in reversed(range(0, n, block)):
+        bb = min(block, n - k0)
+        rhs = x[k0:k0 + bb] - LU[k0:k0 + bb, k0 + bb:] @ x[k0 + bb:]
+        U = LU[k0:k0 + bb, k0:k0 + bb]
+        z = rhs
+        for r in reversed(range(bb)):
+            z = z.at[r].set((z[r] - U[r, r + 1:] @ z[r + 1:]) / U[r, r])
+        x = x.at[k0:k0 + bb].set(z)
+    return x[:, 0] if vec else x
+
+
+def lu_solve(LU: jnp.ndarray, perm: jnp.ndarray, b: jnp.ndarray,
+             unroll: int = LU_UNROLL):
+    """Solve A x = b given lu_factor output. b: [n] or [n, k].
+
+    Chunk-unrolled sequential row recurrences (``unroll`` rows per loop
+    iteration); see :func:`lu_solve_blocked` for the static variant."""
     n = LU.shape[-1]
     idx = jnp.arange(n)
     vec = b.ndim == 1
     y0 = (b[perm, None] if vec else b[perm]).astype(LU.dtype)
+    n_outer = -(-n // unroll)
 
-    def fwd(i, y):
-        s = jnp.where(idx < i, LU[i], 0.0) @ y
-        return y.at[i].set(y[i] - s)
+    def fwd(o, y):
+        for d in range(unroll):
+            i = o * unroll + d
+            s = jnp.where(idx < i, LU[i], 0.0) @ y
+            y2 = y.at[i].set(y[i] - s)
+            y = jnp.where(i < n, y2, y)
+        return y
 
-    def bwd(j, x):
-        i = n - 1 - j
-        s = jnp.where(idx > i, LU[i], 0.0) @ x
-        return x.at[i].set((x[i] - s) / LU[i, i])
+    def bwd(o, x):
+        for d in range(unroll):
+            j = o * unroll + d
+            i = n - 1 - j
+            s = jnp.where(idx > i, LU[i], 0.0) @ x
+            x2 = x.at[i].set((x[i] - s) / LU[i, i])
+            x = jnp.where(i >= 0, x2, x)
+        return x
 
-    y = lax.fori_loop(0, n, fwd, y0)
-    x = lax.fori_loop(0, n, bwd, y)
+    y = lax.fori_loop(0, n_outer, fwd, y0)
+    x = lax.fori_loop(0, n_outer, bwd, y)
     return x[:, 0] if vec else x
 
 

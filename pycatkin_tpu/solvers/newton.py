@@ -56,8 +56,21 @@ class SolverOptions(NamedTuple):
     rate_tol_rel: float = 1.0e-9  # tolerance relative to the gross-flux scale
     coverage_tol: float = 5.0e-2  # allowed deviation of group sums from 1
     neg_tol: float = 5.0e-3      # allowed negative-coverage excursion
+    # PTC pacing. The conservative defaults (slow ramp from a tiny
+    # pseudo-step) are the right trade for SMALL networks, where one
+    # iteration is cheap and robustness across 1e4-1e5 heterogeneous
+    # lanes dominates: round-3 measurement on the 256x256 COOx volcano
+    # found aggressive pacing (dt0=1e-3, grow 6) HALVED throughput and
+    # left 43/65536 lanes unconverged (0 under the defaults). For LARGE
+    # per-lane systems the economics invert -- each iteration pays a
+    # full n^2-Jacobian + n^3-LU, so ramp iterations are the cost
+    # center: the same aggressive pacing solved bench config 5 (n_dyn
+    # 190) 2.3x faster with unchanged convergence. Tune dt0/dt_grow_min
+    # up for big stiff networks (see bench_suite.config_5,
+    # docs/perf_config5.md).
     dt0: float = 1.0e-9          # initial pseudo-time step
     dt_max: float = 1.0e20
+    dt_grow_min: float = 2.0     # guaranteed SER growth per accepted step
     max_steps: int = 200         # PTC iterations per attempt
     max_attempts: int = 5
     floor: float = 1.0e-32       # reference min_tol
@@ -150,7 +163,8 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         # SER with guaranteed geometric growth on accept: plain
         # residual-ratio SER stalls when dt is tiny (the residual barely
         # changes, ratio ~ 1, dt never grows). dt -> inf recovers Newton.
-        grow = jnp.maximum(2.0, fnorm / jnp.maximum(fnorm_new, 1e-300))
+        grow = jnp.maximum(opts.dt_grow_min,
+                           fnorm / jnp.maximum(fnorm_new, 1e-300))
         dt_new = jnp.where(accept,
                            jnp.clip(dt * jnp.minimum(grow, 1.0e6),
                                     1e-14, opts.dt_max),
@@ -228,7 +242,22 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         # exactly as in the PTC step.
         scale = opts.rate_tol + opts.rate_tol_rel * gross
         J = jac_fn(x) / scale[:, None]
-        A = jnp.where(M[:, None] > 0, R, J.T @ J + lam * eye)
+        JtJ = J.T @ J
+        # Scale-invariant damping: lam multiplies the LARGEST diagonal
+        # entry of JtJ, not bare identity -- J is residual-scaled
+        # (entries ~1/rate_tol above the raw Jacobian), so JtJ entries
+        # dwarf any bounded absolute lam and plain lam*eye degenerates
+        # to undamped Gauss-Newton that rejects every step on hard
+        # lanes. Anchoring lam to max diag makes the damping sweep
+        # [1e-12, 1e12] span "pure Gauss-Newton" to "tiny gradient
+        # step" regardless of the residual scaling. (Classic per-
+        # variable Marquardt diag(JtJ) damping was measured to stall
+        # outright on the COOx volcano test point: near-empty coverages
+        # carry ~zero columns whose relative damping distorts the step
+        # direction; the uniform max-diag anchor preserves the
+        # Gauss-Newton direction as lam -> 0.)
+        dmax = jnp.maximum(jnp.max(jnp.diag(JtJ)), 1e-300)
+        A = jnp.where(M[:, None] > 0, R, JtJ + (lam * dmax) * eye)
         g = jnp.where(M > 0, 0.0, J.T @ (F / scale))
         dx = linalg.solve(A, -g * (1.0 - M))
         x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
@@ -247,8 +276,16 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
 
     F0, gross0 = fscale_fn(x0)
     f0 = _rnorm(F0, gross0, opts)
+    # Start essentially undamped (Gauss-Newton): with the max-diag
+    # anchor a large initial lam means genuinely small steps, and near
+    # the projection operators (clamp + group renormalization) a small
+    # enough step changes nothing -- the strict-decrease accept test
+    # then rejects forever and lam only ratchets up (measured stall on
+    # the COOx volcano from a uniform start). Rejections ramp lam 10x
+    # per iteration, so the damped regime is a few iterations away
+    # whenever GN steps actually fail.
     x, F, gross, fnorm, lam, k = jax.lax.while_loop(
-        cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-3, x0.dtype), 0))
+        cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-10, x0.dtype), 0))
     return x, fnorm, k
 
 

@@ -52,16 +52,45 @@ class ODEOptions(NamedTuple):
     safety: float = 0.9
     min_factor: float = 0.2
     max_factor: float = 8.0
-    # Stage-Newton iterate clamp: bound on |y| during implicit stage
-    # solves. The default suits the chemistry layer (coverages in [0,1],
-    # gas in bar, so the true state is O(1)); callers integrating
-    # differently-scaled systems must raise it. Runaway iterates past
-    # the clamp would overflow the f32-ranged exponent of TPU's f64
-    # emulation and poison the step controller.
+    # Stage-Newton iterate clamps: bounds on y during implicit stage
+    # solves. The defaults suit the chemistry layer (coverages in
+    # [0, 1], gas partial pressures nonnegative and O(1) bar); callers
+    # integrating differently-scaled systems must widen them. Runaway
+    # iterates past the upper clamp would overflow the f32-ranged
+    # exponent of TPU's f64 emulation and poison the step controller.
+    # A converged stage solution SITTING ON either boundary rejects the
+    # step (see _stage_solve), so both a mis-scaled system and a
+    # spurious large-h stage root surface as rejections instead of a
+    # silently pinned/hopped trajectory. The tight LOWER clamp is the
+    # load-bearing one: at h far beyond the local timescale the frozen-
+    # Jacobian stage Newton can converge onto a phantom near-equilibrium
+    # of the rate equations (measured on the CH4 network: +-1e3 states
+    # entered through a waived/filtered error test, which at huge h is
+    # blind -- the stiff filter divides the estimate by h). Conservation
+    # is preserved exactly by RK stages, so any large phantom root MUST
+    # carry compensating NEGATIVE in-group entries (a +1+a phantom
+    # coverage forces a -a partner in its site group); clamping below at
+    # clamp_lo bounds the whole class: the projection squeezes phantom
+    # roots against the boundary, so an accepted pseudo-state can sit at
+    # most ~|clamp_lo| from the physical one, where the Newton finish
+    # absorbs it. Real trajectories only go negative by
+    # local-error-sized amounts (measured across the test mechanisms:
+    # ~-1e-9 worst), so -1e-6 leaves three decades of headroom for
+    # genuine dynamics while pinning phantoms to irrelevance.
     clamp: float = 1.0e3
+    clamp_lo: float = -1.0e-6
+    # Max relative state motion per error-waived (relaxed) step; see the
+    # small-move gate in _advance_to. inf disables the gate.
+    relax_dy: float = 0.1
+    # Domain-steadiness relative tolerance used by the relax/finish
+    # oracles (net flux <= steady_rel * gross flux). Matches the steady
+    # solver's SolverOptions.rate_tol_rel default; thread a tightened
+    # value here when tightening the solver, so transient error-test
+    # waiving is judged at the same level.
+    steady_rel: float = 1.0e-9
 
 
-def _stage_solve(f, msolve, z0, rhs_const, h, scale, clamp):
+def _stage_solve(f, msolve, z0, rhs_const, h, scale, opts):
     """Solve z = rhs_const + d*h*f(z) by simplified Newton with the frozen
     factorized iteration matrix (I - d*h*J).
 
@@ -74,17 +103,23 @@ def _stage_solve(f, msolve, z0, rhs_const, h, scale, clamp):
         z, _ = carry
         res = z - rhs_const - D * h * f(z)
         dz = msolve(res)
-        # Clamp runaway iterates (ODEOptions.clamp): an overshooting
-        # iterate feeds k*prod(y) past the exponent range of TPU's
-        # f32-ranged f64 emulation, and the resulting inf/nan would
-        # poison the step controller instead of just costing a
+        # Clamp runaway iterates (ODEOptions.clamp/clamp_lo): an
+        # overshooting iterate feeds k*prod(y) past the exponent range
+        # of TPU's f32-ranged f64 emulation, and the resulting inf/nan
+        # would poison the step controller instead of just costing a
         # rejection.
-        z_new = jnp.clip(z - dz, -clamp, clamp)
+        z_new = jnp.clip(z - dz, opts.clamp_lo, opts.clamp)
         dz_norm = jnp.sqrt(jnp.mean((dz / scale) ** 2))
         return z_new, dz_norm
     z, dz_norm = jax.lax.fori_loop(0, _NEWTON_ITERS, body,
                                    (z0, jnp.asarray(jnp.inf, z0.dtype)))
-    converged = dz_norm < 0.1
+    # A solution pinned on a clamp boundary is not a solution of the
+    # stage equations (the clamp truncated it), and one that CONVERGED
+    # against the lower bound is a phantom root (see ODEOptions.clamp_lo
+    # rationale): reject the step so the controller shrinks h instead of
+    # accepting a hopped/clamped trajectory.
+    on_clamp = jnp.any((z >= opts.clamp) | (z <= opts.clamp_lo))
+    converged = (dz_norm < 0.1) & ~on_clamp
     return z, converged
 
 
@@ -103,14 +138,14 @@ def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions, f0=None):
     scale0 = opts.atol + opts.rtol * jnp.abs(y)
     # TR stage to t + gamma*h
     g, conv1 = _stage_solve(f, msolve, y + GAMMA * h * f0,
-                            y + D * h * f0, h, scale0, opts.clamp)
+                            y + D * h * f0, h, scale0, opts)
     fg = f(g)
     # BDF2 stage to t + h
     c_g = 1.0 / (GAMMA * (2.0 - GAMMA))
     c_y = (1.0 - GAMMA) ** 2 / (GAMMA * (2.0 - GAMMA))
     rhs_const = c_g * g - c_y * y
     y1, conv2 = _stage_solve(f, msolve, rhs_const + D * h * fg, rhs_const,
-                             h, scale0, opts.clamp)
+                             h, scale0, opts)
     f1 = f(y1)
 
     # Embedded error, stiffly filtered.
@@ -148,30 +183,52 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
 
     def body(state):
         y, t, h, k, ok = state
-        # Integrate-to-steady shortcut: once even a constant-derivative
+        # Integrate-to-steady shortcut: once a constant-derivative
         # extrapolation over the WHOLE remaining span stays within the
-        # error tolerance, y is steady to working accuracy and the
-        # segment is done. Without this, huge trailing spans (the
-        # reference's times=[0, 1e12..1e16] pattern) stall: near steady
-        # state (I - d*h*J) inherits the conservation null space of J at
-        # large h, the stage Newton degrades, and h plateaus until
-        # max_steps is burned.
+        # error tolerance AND the domain oracle confirms relative
+        # steadiness, the segment is done. Without this, huge trailing
+        # spans (the reference's times=[0, 1e12..1e16] pattern) stall:
+        # near steady state (I - d*h*J) inherits the conservation null
+        # space of J at large h, the stage Newton degrades, and h
+        # plateaus until max_steps is burned.
+        #
+        # The span criterion is NEVER applied on its own: a mode growing
+        # exponentially from sub-atol amplitude (ignition/induction
+        # transient) has a tiny instantaneous derivative but a huge
+        # eventual change, so constant-derivative extrapolation would
+        # skip it. Such a mode has net flux ~ gross flux, which the
+        # relax/steady oracles (net <= tol * gross) reject -- gating on
+        # them kills exactly that failure mode. Generic callers with no
+        # oracle get no shortcut (they must integrate the whole span).
         f0 = f(y)
         remaining = t1 - t
-        steady = jnp.all(jnp.abs(f0) * remaining
-                         <= opts.atol + opts.rtol * jnp.abs(y))
-        if steady_fn is not None:
-            # The span criterion above cannot distinguish a genuinely
-            # drifting state from f64 cancellation noise (net flux ~
-            # eps * gross flux) over huge remaining spans; the domain
-            # oracle can.
-            steady = steady | steady_fn(y)
+        span_ok = jnp.all(jnp.abs(f0) * remaining
+                          <= opts.atol + opts.rtol * jnp.abs(y))
+        oracle = (steady_fn(y) if steady_fn is not None
+                  else jnp.asarray(False))
+        guard = relax_fn(y) if relax_fn is not None else oracle
+        # The hard oracle alone also ends the segment: it certifies
+        # steadiness at the arithmetic floor, where further stepping
+        # only accumulates rounding noise.
+        steady = oracle | (span_ok & guard)
         h_try = jnp.minimum(h, remaining)
         final = h >= remaining
         y_new, err_ratio, step_ok = _trbdf2_step(f, jac, y, t, h_try, opts,
                                                  f0=f0)
         relaxed = (relax_fn(y) if relax_fn is not None
                    else jnp.asarray(False))
+        # The waiver only covers noise-limited near-steady stepping, so
+        # a relaxed step must barely MOVE the state. Without this gate,
+        # a large-h stage Newton can converge onto a spurious root of
+        # the stage equations far from the trajectory (measured on the
+        # CH4 network: its metastable plateau at t~1e8 s hopped onto a
+        # +-1e3 pseudo-state once h outgrew the plateau) and the waived
+        # error test would accept the hop. Genuine relaxation tails move
+        # ~nothing per step; genuine drift past the gate falls back to
+        # the error test.
+        small_move = (jnp.max(jnp.abs(y_new - y) / (1.0 + jnp.abs(y)))
+                      <= opts.relax_dy)
+        relaxed = relaxed & small_move
         accept = step_ok & ((err_ratio <= 1.0) | relaxed)
         factor = jnp.where(
             err_ratio > 0,

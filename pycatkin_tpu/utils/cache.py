@@ -29,19 +29,48 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     defaults to 1 s / 0 bytes minimums, which would skip exactly the
     many-small-programs pattern the sweep drivers produce.
 
-    Returns the cache directory in use. Safe to call repeatedly.
+    Returns the cache directory in use (None when disabled). Safe to
+    call repeatedly.
+
+    CPU backend: the cache is DISABLED. XLA:CPU persists AOT-compiled
+    executables tagged with the compiling toolchain's CPU-feature set;
+    reloading warns about feature mismatches (cpu_aot_loader) and can
+    die executing them -- measured in this environment as a
+    deterministic segfault inside compilation_cache
+    .get_executable_and_time on a freshly written entry. CPU compiles
+    are cheap relative to TPU's, so tests/virtual-mesh runs simply
+    recompile; the cache stays on for TPU, where one volcano-scale
+    compile costs minutes.
     """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
     if cache_dir is None:
         cache_dir = os.environ.get("PYCATKIN_JAX_CACHE_DIR", _DEFAULT_DIR)
     try:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
         # Read-only install (e.g. system site-packages): fall back to a
-        # per-user cache rather than aborting the entry point.
-        import tempfile
-        cache_dir = os.path.join(tempfile.gettempdir(),
-                                 f"pycatkin_jax_cache_{os.getuid()}")
-        os.makedirs(cache_dir, exist_ok=True)
+        # per-user cache rather than aborting the entry point. Prefer the
+        # user's own cache dir over a world-shared temp path, and derive
+        # the user id portably (os.getuid does not exist on Windows).
+        if hasattr(os, "getuid"):
+            uid = str(os.getuid())
+        else:
+            import getpass
+            uid = getpass.getuser()
+        base = os.environ.get("XDG_CACHE_HOME",
+                              os.path.join(os.path.expanduser("~"),
+                                           ".cache"))
+        try:
+            cache_dir = os.path.join(base, f"pycatkin_jax_cache_{uid}")
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError:
+            import tempfile
+            cache_dir = os.path.join(tempfile.gettempdir(),
+                                     f"pycatkin_jax_cache_{uid}")
+            os.makedirs(cache_dir, exist_ok=True)
 
     import jax
 

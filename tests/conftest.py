@@ -40,3 +40,26 @@ def ref_root():
     if not os.path.isdir(REFERENCE_ROOT):
         pytest.skip("reference tree not available")
     return REFERENCE_ROOT
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables between test modules.
+
+    XLA:CPU accumulates per-process JIT state across the suite's ~100
+    compiled programs; past a threshold the compile-and-load path
+    segfaults (measured deterministically ~40 tests in, gone when the
+    crashing module runs alone). Specs are per-module anyway, so
+    dropping the program caches costs little recompilation and keeps
+    the long-lived pytest process inside the safe regime.
+    """
+    yield
+    import jax
+
+    from pycatkin_tpu.api import presets
+    from pycatkin_tpu.parallel.batch import clear_program_caches
+
+    clear_program_caches()
+    presets._net_rates_program.cache_clear()
+    presets._drc_program.cache_clear()
+    jax.clear_caches()

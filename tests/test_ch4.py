@@ -125,3 +125,28 @@ def test_steady_state_solves(ch4):
     sums = np.asarray(ch4.spec.groups) @ y
     np.testing.assert_allclose(sums, 1.0, atol=5e-2)
     assert np.all(y[ch4.spec.dynamic_indices] >= -1e-8)
+
+
+def test_steady_root_is_physical(ch4):
+    """The default find_steady lands on the PHYSICAL root -- the t->inf
+    limit of the start state. The CH4 network is multistable (several
+    individually stable branches), so an unseeded Newton solve can
+    converge onto a branch the reactor never reaches; the reference
+    avoids this by always seeding find_steady from the transient tail
+    (old_system.py:393-395). With no stored transient, the facade now
+    integrates first (times are configured), then polishes."""
+    sim = ch4.copy()
+    sim.params["n_out"] = 40
+    res = sim.find_steady()   # no stored solution -> auto-integrates
+    assert bool(res.success)
+    assert sim.solution is not None, "transient seeding did not run"
+    dyn = sim.spec.dynamic_indices
+    y_inf = sim.solution[-1][dyn]
+    # Basin identity: the polished root is the transient tail's root.
+    # 5e-6 headroom: a hard tail can carry a ~clamp_lo (1e-6) phantom
+    # projection offset when the Newton finish declines to replace it.
+    np.testing.assert_allclose(np.asarray(res.x)[dyn], y_inf, atol=5e-6)
+    # ... and it is dynamically stable.
+    from pycatkin_tpu import engine
+    assert bool(engine.check_stability(sim.spec, sim.conditions(),
+                                       np.asarray(res.x)))

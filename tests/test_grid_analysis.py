@@ -68,3 +68,26 @@ def test_heatmap_renders(tmp_path):
     fig, ax = convergence_heatmap(ok, x=x, y=x,
                                   path=str(tmp_path / "conv.png"))
     assert (tmp_path / "conv.png").exists()
+
+
+def test_replay_lane_diagnoses_point(capsys):
+    """replay_lane re-solves one sweep lane with verbose diagnostics
+    (the debugging half of reference check_convergence,
+    analysis.py:27-76): strategies chain until one converges, and the
+    report carries residual/iterations/group sums/stability."""
+    from pycatkin_tpu.analysis.grid import replay_lane
+    from pycatkin_tpu.parallel.batch import stack_conditions
+    from tests.test_verdicts import _toy_ads_system
+
+    sim = _toy_ads_system("detailed_balance")
+    spec = sim.spec
+    conds = stack_conditions([sim.conditions()] * 3)
+    res, report = replay_lane(spec, conds, lane=1)
+    assert bool(res.success)
+    assert report["lane"] == 1
+    assert report["tries"][0]["strategy"] == "ptc"
+    assert report["tries"][-1]["success"]
+    assert report["tries"][-1]["stable"] is True
+    sums = np.asarray(report["tries"][-1]["group_sums"])
+    np.testing.assert_allclose(sums, 1.0, atol=5e-2)
+    assert "replay lane 1" in capsys.readouterr().out

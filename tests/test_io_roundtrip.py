@@ -144,3 +144,10 @@ def test_save_pdb_structures(ref_root, tmp_path):
         co = open(written["CO"]).read().splitlines()
         atoms = [ln for ln in co if ln.startswith("HETATM")]
         assert len(atoms) == 2
+    # Headless .png render next to every .pdb (reference view_atoms
+    # image export, state.py:444-463).
+    for name, fname in written.items():
+        png = fname[:-4] + ".png"
+        assert os.path.isfile(png), f"missing render {png}"
+        with open(png, "rb") as fh:
+            assert fh.read(8) == b"\x89PNG\r\n\x1a\n"

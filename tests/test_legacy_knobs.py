@@ -1,0 +1,134 @@
+"""Round-3 hardening of workflow edges.
+
+Covers: legacy solver knobs honored-or-rejected instead of silently
+ignored (reference old_system.py:154-174, 350-376); the non-positive-TOF
+activity guard (reference old_system.py:517-529 silently NaNs); and the
+FD-DRC convergence flag threaded through the batched sweep path
+(engine.drc_fd return_success -> presets._drc_program -> _sweep warning).
+"""
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.api.system import System
+from pycatkin_tpu.constants import R, eVtokJ, h, kB
+from pycatkin_tpu.frontend.reactions import UserDefinedReaction
+from pycatkin_tpu.frontend.states import State
+from pycatkin_tpu.models.reactor import InfiniteDilutionReactor
+
+eVtoJmol = eVtokJ * 1.0e3
+
+
+# ---------------------------------------------------------------------
+# legacy solver knobs (reference old_system.py:154-174)
+def test_ode_solver_aliases_accepted():
+    for alias in ("trbdf2", "solve_ivp", "ode"):
+        System(ode_solver=alias)
+
+
+def test_unknown_ode_solver_rejected():
+    with pytest.raises(ValueError, match="ode_solver"):
+        System(ode_solver="lsoda")
+
+
+def test_nsteps_maps_to_max_steps():
+    from pycatkin_tpu.solvers.ode import ODEOptions
+    assert System(nsteps=123)._ode_options().max_steps == 123
+    # the legacy default budget maps onto the native default
+    assert System()._ode_options().max_steps == ODEOptions().max_steps
+
+
+def test_ftol_xtol_map_to_rate_tol():
+    """Reference least_squares stops when EITHER ftol or xtol fires
+    (old_system.py:426-428): the tightest becomes the absolute residual
+    tolerance."""
+    assert System(ftol=1.0e-12).solver_options().rate_tol == 1.0e-12
+    assert System(xtol=1.0e-10).solver_options().rate_tol == 1.0e-10
+    assert System(ftol=1.0e-9,
+                  xtol=1.0e-11).solver_options().rate_tol == 1.0e-11
+    # explicit overrides still win
+    assert System(ftol=1.0e-12).solver_options(
+        rate_tol=1.0e-6).rate_tol == 1.0e-6
+
+
+# ---------------------------------------------------------------------
+# non-positive TOF activity guard (reference old_system.py:517-529)
+def test_activity_from_tof_uses_magnitude():
+    a_pos = float(engine.activity_from_tof(1.0e-5, 500.0))
+    a_neg = float(engine.activity_from_tof(-1.0e-5, 500.0))
+    assert np.isfinite(a_neg)
+    assert a_neg == pytest.approx(a_pos)
+    assert float(engine.activity_from_tof(0.0, 500.0)) == -np.inf
+
+
+def test_system_activity_warns_on_reverse_tof():
+    sim = System(T=500.0)
+    # A net TOF < 0: the selected steps run in reverse at the solution.
+    sim.run_and_return_tof = lambda *a, **k: -1.0e-5
+    with pytest.warns(UserWarning, match="non-positive"):
+        a = sim.activity(["r1"])
+    assert a == pytest.approx(float(engine.activity_from_tof(1.0e-5,
+                                                             500.0)))
+
+
+# ---------------------------------------------------------------------
+# FD-DRC convergence flag through the batched sweep path
+def _ga_for_rate(k, T):
+    return -R * T * np.log(k * h / (kB * T)) / eVtoJmol
+
+
+def _toy_surface_system(T=500.0):
+    """Two-state surface mechanism (no gas thermo needed): the sweep
+    machinery exercises transient + steady + DRC batched programs on it
+    in a fraction of a second."""
+    s = State(name="s", state_type="surface")
+    sa = State(name="sa", state_type="adsorbate")
+    r1 = UserDefinedReaction(name="r1", reac_type="arrhenius",
+                             reversible=True,
+                             reactants=[s], products=[sa],
+                             dGrxn_user=0.05,
+                             dGa_fwd_user=_ga_for_rate(5.0, T))
+    sim = System(start_state={"s": 1.0}, T=T, p=1.0e5,
+                 times=[0.0, 100.0])
+    sim.add_state(s)
+    sim.add_state(sa)
+    sim.add_reaction(r1)
+    sim.add_reactor(InfiniteDilutionReactor())
+    return sim.build()
+
+
+def test_sweep_warns_on_unconverged_fd_drc(monkeypatch, capsys):
+    """A failing perturbed solve in the batched FD-DRC path must surface
+    as a warning naming the sweep (round-2 verdict: the facade warned,
+    the batched path silently returned unreliable xi)."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu.api import presets
+
+    def failing_drc_fd(spec, cond, tof_terms, eps=1e-3, opts=None,
+                       x0=None, key=None, return_success=False):
+        xi = jnp.zeros(spec.n_reactions)
+        return (xi, jnp.asarray(False)) if return_success else xi
+
+    monkeypatch.setattr(engine, "drc_fd", failing_drc_fd)
+    sim = _toy_surface_system()
+    presets.run_temperatures(sim, [500.0, 510.0],
+                             steady_state_solve=True, tof_terms=["r1"],
+                             drc_mode="fd")
+    err = capsys.readouterr().err
+    assert "DRC" in err and "unreliable" in err
+
+
+def test_sweep_fd_drc_converged_no_warning(capsys):
+    """The real FD-DRC on the toy system: all perturbed solves converge,
+    so the sweep must NOT warn."""
+    from pycatkin_tpu.api import presets
+
+    sim = _toy_surface_system()
+    finals, rates, drcs = presets.run_temperatures(
+        sim, [500.0, 510.0], steady_state_solve=True, tof_terms=["r1"],
+        drc_mode="fd")
+    err = capsys.readouterr().err
+    assert "unreliable" not in err
+    assert set(drcs) == {500.0, 510.0}

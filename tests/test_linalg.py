@@ -69,3 +69,26 @@ def test_solve_vmaps():
     x = np.asarray(jax.vmap(linalg.solve)(jnp.asarray(A), jnp.asarray(b)))
     ref = np.linalg.solve(A, b[..., None])[..., 0]
     np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-11)
+
+
+@pytest.mark.parametrize("n", [5, 48, 49, 190])
+def test_blocked_lu_matches_plain(n):
+    """The statically-unrolled blocked factorization (kept as the
+    reference implementation for a future Pallas panel kernel; not in
+    the default dispatch -- TPU compile-time wall, see
+    docs/perf_config5.md) reconstructs PA = LU to machine precision and
+    its solves agree with the chunked kernels."""
+    rng = np.random.default_rng(n)
+    A = rng.standard_normal((n, n)) * np.exp(rng.uniform(-6, 6, (n, 1)))
+    b = rng.standard_normal(n)
+    LU, perm = linalg.lu_factor_blocked(jnp.asarray(A))
+    LUn, permn = np.asarray(LU), np.asarray(perm)
+    L = np.tril(LUn, -1) + np.eye(n)
+    U = np.triu(LUn)
+    rec = np.max(np.abs(L @ U - A[permn])) / np.max(np.abs(A))
+    assert rec < 1e-13
+    x = np.asarray(linalg.lu_solve_blocked(LU, perm, jnp.asarray(b)))
+    r = np.max(np.abs(A @ x - b)) / np.max(np.abs(b))
+    assert r < 1e-7
+    x2 = np.asarray(linalg.lu_solve(LU, perm, jnp.asarray(b)))
+    np.testing.assert_allclose(x, x2, rtol=1e-9, atol=1e-12)

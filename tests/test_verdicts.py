@@ -125,6 +125,32 @@ def test_batched_stability_mask(bistable):
     np.testing.assert_array_equal(mask, [False, True, True])
 
 
+def test_sweep_retries_stability_demoted_lanes(bistable):
+    """A sweep lane seeded ON the unstable root converges there with zero
+    residual; the stability verdict demotes it, and the sweep's
+    random-restart rescue must land it on a STABLE root with
+    success=True (round-2 verdict: demoted lanes were abandoned; facade
+    parity with api/system.py find_steady's 3-retry loop)."""
+    from pycatkin_tpu.parallel.batch import (stack_conditions,
+                                             sweep_steady_state)
+    spec = bistable.spec
+    dyn = np.asarray(spec.dynamic_indices)
+    conds = stack_conditions([bistable.conditions()] * 3)
+    x0 = np.stack([_full_y(bistable, A_UNSTABLE)[dyn],
+                   _full_y(bistable, A_STABLE)[dyn],
+                   _full_y(bistable, 0.0)[dyn]])
+    out = sweep_steady_state(spec, conds, x0=x0, check_stability=True)
+    assert bool(np.all(np.asarray(out["success"])))
+    assert bool(np.all(np.asarray(out["stable"])))
+    a = np.asarray(out["y"])[:, spec.sindex("sa")]
+    # Lane 0 must have ESCAPED the unstable root onto a stable one.
+    assert abs(a[0] - A_UNSTABLE) > 1e-3
+    assert (abs(a[0] - A_STABLE) < 1e-6) or (abs(a[0]) < 1e-6)
+    # Lanes seeded on stable roots stay there.
+    assert abs(a[1] - A_STABLE) < 1e-6
+    assert abs(a[2]) < 1e-6
+
+
 # ---------------------------------------------------------------------
 # Collision desorption model
 def _kdes_reference(T, mass, area, sigma, inertia, des_en):

@@ -1,0 +1,146 @@
+"""Generate docs/api/*.md from the package's own docstrings+signatures.
+
+The per-module API reference (parity with the reference's sphinx-autodoc
+tree, /root/reference/docs/api/) is rendered to plain markdown so it
+reads on any host (GitHub, editors) without a doc build. Re-run after
+changing public surfaces:
+
+    python tools/gen_api_docs.py
+"""
+
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "api")
+
+# One page per module group, mirroring the package layout.
+PAGES = {
+    "constants": ["pycatkin_tpu.constants"],
+    "frontend": ["pycatkin_tpu.frontend.states",
+                 "pycatkin_tpu.frontend.reactions",
+                 "pycatkin_tpu.frontend.parsers",
+                 "pycatkin_tpu.frontend.loader",
+                 "pycatkin_tpu.frontend.spec"],
+    "ops": ["pycatkin_tpu.ops.thermo", "pycatkin_tpu.ops.rates",
+            "pycatkin_tpu.ops.network", "pycatkin_tpu.ops.linalg"],
+    "solvers": ["pycatkin_tpu.solvers.newton", "pycatkin_tpu.solvers.ode"],
+    "engine": ["pycatkin_tpu.engine"],
+    "api": ["pycatkin_tpu.api.system", "pycatkin_tpu.api.presets",
+            "pycatkin_tpu.api.plotting"],
+    "parallel": ["pycatkin_tpu.parallel.batch"],
+    "analysis": ["pycatkin_tpu.analysis.energy_span",
+                 "pycatkin_tpu.analysis.grid",
+                 "pycatkin_tpu.analysis.uncertainty"],
+    "models": ["pycatkin_tpu.models.reactor", "pycatkin_tpu.models.coox",
+               "pycatkin_tpu.models.synthetic"],
+    "utils": ["pycatkin_tpu.utils.io", "pycatkin_tpu.utils.profiling",
+              "pycatkin_tpu.utils.cache"],
+}
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _doc(obj, indent=""):
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return ""
+    return "\n".join(indent + line for line in doc.splitlines())
+
+
+def _is_namedtuple(cls):
+    return issubclass(cls, tuple) and hasattr(cls, "_fields")
+
+
+def render_module(modname):
+    mod = importlib.import_module(modname)
+    lines = [f"## `{modname}`", ""]
+    mdoc = inspect.getdoc(mod)
+    if mdoc:
+        lines += [mdoc, ""]
+
+    members = vars(mod)
+    classes = [(n, o) for n, o in members.items()
+               if inspect.isclass(o) and o.__module__ == modname
+               and not n.startswith("_")]
+    funcs = [(n, o) for n, o in members.items()
+             if inspect.isfunction(o) and o.__module__ == modname
+             and not n.startswith("_")]
+    consts = [(n, o) for n, o in members.items()
+              if isinstance(o, (int, float)) and not n.startswith("_")
+              and not isinstance(o, bool)]
+    if consts:
+        lines += ["| constant | value |", "|---|---|"]
+        lines += [f"| `{n}` | `{v!r}` |" for n, v in consts]
+        lines += [""]
+
+    for name, cls in classes:
+        if _is_namedtuple(cls):
+            lines += [f"### class `{name}`", ""]
+            d = _doc(cls)
+            if d:
+                lines += [d, ""]
+            lines += ["Fields: " + ", ".join(
+                f"`{f}`" for f in cls._fields), ""]
+            continue
+        lines += [f"### class `{name}{_sig(cls)}`", ""]
+        d = _doc(cls)
+        if d:
+            lines += [d, ""]
+        methods = [(mn, mo) for mn, mo in vars(cls).items()
+                   if inspect.isfunction(mo) and not mn.startswith("_")]
+        props = [(mn, mo) for mn, mo in vars(cls).items()
+                 if isinstance(mo, property) and not mn.startswith("_")]
+        for mn, mo in methods:
+            lines += [f"#### `{name}.{mn}{_sig(mo)}`", ""]
+            d = _doc(mo)
+            if d:
+                lines += [d, ""]
+        if props:
+            lines += ["Properties: " + ", ".join(
+                f"`{mn}`" for mn, _ in props), ""]
+
+    for name, fn in funcs:
+        lines += [f"### `{name}{_sig(fn)}`", ""]
+        d = _doc(fn)
+        if d:
+            lines += [d, ""]
+    return "\n".join(lines)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    index = ["# API reference", "",
+             "Generated from the package's docstrings by "
+             "`tools/gen_api_docs.py`; regenerate after public-surface "
+             "changes. Units and conventions: see "
+             "[the docs index](../index.md#units-and-conventions).", ""]
+    for page, modules in PAGES.items():
+        body = ["# `" + page + "`", ""]
+        for modname in modules:
+            body.append(render_module(modname))
+            body.append("")
+        path = os.path.join(OUT, f"{page}.md")
+        with open(path, "w") as fh:
+            fh.write("\n".join(body))
+        mods = ", ".join(f"`{m.split('pycatkin_tpu.')[-1]}`"
+                         for m in modules)
+        index.append(f"- [{page}]({page}.md) — {mods}")
+        print(f"wrote {path}")
+    with open(os.path.join(OUT, "index.md"), "w") as fh:
+        fh.write("\n".join(index) + "\n")
+    print("wrote docs/api/index.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Decomposition profile of bench config 5 (synthetic 200x500 sweep).
+
+Answers the round-2 verdict's question: WHERE does the 200x500 batched
+steady solve spend its time? Times each component of one PTC iteration
+at the exact benchmark shape (128 lanes, n_dyn=190), reports iteration
+counts from the real sweep, and reconciles component times against the
+measured end-to-end wall time. Run on the benchmark device:
+
+    python tools/profile_config5.py
+
+Results of a run are committed in docs/perf_config5.md.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.ops import linalg
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})")
+
+    sim = synthetic_system(n_species=200, n_reactions=500, seed=0)
+    spec = sim.spec
+    dyn = np.asarray(spec.dynamic_indices)
+    n_dyn = len(dyn)
+    print(f"n_dyn={n_dyn}, n_reactions={spec.n_reactions}")
+
+    Ts = np.linspace(420.0, 700.0, 8)
+    ps = np.logspace(4.0, 6.0, 4)
+    dEs = np.linspace(-0.15, 0.15, 4)
+    TT, PP, EE = np.meshgrid(Ts, ps, dEs, indexing="ij")
+    n = TT.size
+    base = sim.conditions()
+    eps = np.zeros((n, len(spec.snames)))
+    eps[:, spec.is_adsorbate.astype(bool)] = EE.ravel()[:, None]
+    conds = broadcast_conditions(base, n)._replace(
+        T=TT.ravel(), p=PP.ravel(), eps=eps)
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+
+    # ------------------------------------------------------------------
+    # end-to-end sweep (the benchmark measurement) + iteration counts
+    warm = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
+                              tof_mask=mask)
+    jax.block_until_ready(warm["y"])
+    t0 = time.perf_counter()
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    jax.block_until_ready(out["y"])
+    total_s = time.perf_counter() - t0
+    iters = np.asarray(out["iterations"])
+    atts = np.asarray(out["attempts"])
+    print(f"\nend-to-end sweep: {total_s:.3f} s for {n} lanes "
+          f"({n/total_s:.1f} lanes/s), "
+          f"{int(np.sum(np.asarray(out['success'])))}/{n} converged")
+    print(f"iterations: max={iters.max()} mean={iters.mean():.1f} "
+          f"p50={np.percentile(iters, 50):.0f} "
+          f"p90={np.percentile(iters, 90):.0f}")
+    print(f"attempts:   max={atts.max()} mean={atts.mean():.2f}")
+
+    # ------------------------------------------------------------------
+    # component timings at the same batched shape
+    x0 = jnp.asarray(np.asarray(conds.y0)[:, dyn])
+
+    def jac_one(cond, x):
+        kf, kr, _ = engine.rate_constants(spec, cond)
+        fscale, _, _ = engine._dynamic_fscale(spec, cond, kf, kr)
+        return jax.jacfwd(lambda z: fscale(z)[0])(x)
+
+    def eval_one(cond, x):
+        kf, kr, _ = engine.rate_constants(spec, cond)
+        fscale, _, _ = engine._dynamic_fscale(spec, cond, kf, kr)
+        return fscale(x)
+
+    def rates_one(cond):
+        return engine.rate_constants(spec, cond)[0]
+
+    jac_b = jax.jit(jax.vmap(jac_one))
+    eval_b = jax.jit(jax.vmap(eval_one))
+    rates_b = jax.jit(jax.vmap(rates_one))
+
+    t_jac = timeit(jac_b, conds, x0)
+    t_eval = timeit(eval_b, conds, x0)
+    t_rates = timeit(rates_b, conds)
+    print(f"\nper-iteration components (batched over {n} lanes):")
+    print(f"  jacfwd Jacobian [{n}x{n_dyn}x{n_dyn}]: {t_jac*1e3:8.2f} ms")
+    print(f"  residual+scale eval:                 {t_eval*1e3:8.2f} ms")
+    print(f"  rate constants (per solve, once):    {t_rates*1e3:8.2f} ms")
+
+    A = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (n, n_dyn, n_dyn)) + 10.0 * np.eye(n_dyn))
+    b = jnp.asarray(np.random.default_rng(1).standard_normal((n, n_dyn)))
+    solve_b = jax.jit(jax.vmap(linalg.solve))
+    t_solve = timeit(solve_b, A, b)
+    print(f"  linalg.solve [{n}x{n_dyn}x{n_dyn}]:        {t_solve*1e3:8.2f} ms")
+
+    lu_b = jax.jit(jax.vmap(lambda M: linalg.lu_factor(M)[0]))
+    t_lu = timeit(lu_b, A)
+    print(f"    of which lu_factor:                {t_lu*1e3:8.2f} ms")
+
+    # reconcile: the PTC body does 1 jacfwd + 1 solve + 1 eval per step.
+    per_iter = t_jac + t_solve + t_eval
+    # SIMD: every lane steps until the LAST lane converges (per pass);
+    # the first pass is capped at 100 steps.
+    est = per_iter * iters.max()
+    print(f"\nreconciliation: (jac+solve+eval) = {per_iter*1e3:.2f} ms/iter; "
+          f"x max-iters {iters.max()} = {est:.3f} s "
+          f"vs measured {total_s:.3f} s")
+    print(f"LU share of one iteration: {t_solve/per_iter*100:.0f}% solve, "
+          f"{t_jac/per_iter*100:.0f}% jacobian, "
+          f"{t_eval/per_iter*100:.0f}% eval")
+
+
+if __name__ == "__main__":
+    main()
