@@ -82,7 +82,8 @@ def main():
     from pycatkin_tpu import engine
     from pycatkin_tpu.parallel.batch import sweep_steady_state
 
-    log(f"persistent compilation cache: {cache_dir}")
+    log(f"persistent compilation cache: "
+        f"{cache_dir if cache_dir else 'disabled (cpu backend)'}")
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")

@@ -283,7 +283,7 @@ class System:
         """Transient integration over the configured time span on a
         log-spaced output grid (reference old_system.py:315-383). Stores
         self.times / self.solution."""
-        times = times or self.params["times"]
+        times = times if times is not None else self.params["times"]
         assert times is not None, "System times are not set"
         n_out = n_out or self.params.get("n_out", 300)
         grid = np.asarray(log_time_grid(times[0], times[-1], n_out))
@@ -319,7 +319,13 @@ class System:
         if y0 is not None:
             x0 = np.asarray(y0)[self.spec.dynamic_indices]
         elif use_transient_guess:
-            if self.solution is None and self.params.get("times"):
+            # `is not None` + len: sweep drivers mutate params directly,
+            # so "times" may arrive as a numpy array (whose truth value
+            # is ambiguous) -- same latent pattern as solve_odes'
+            # `times or ...`.
+            times = self.params.get("times")
+            if (self.solution is None and times is not None
+                    and len(times) > 0):
                 # Multistable networks (e.g. the CH4 oxidation mechanism)
                 # carry several stable roots; the physically meaningful
                 # one is the t->inf limit of the start state. The

@@ -383,7 +383,8 @@ def transient(spec: ModelSpec, cond: Conditions, save_ts,
     ys, ok = integrate(rhs, jac, jnp.asarray(cond.y0, dtype=jnp.float64),
                        jnp.asarray(save_ts), opts, steady_fn=steady_fn,
                        relax_fn=relax_fn)
-    y_fin, ok = transient_finish(spec, cond, ys[-1], ok)
+    y_fin, ok = transient_finish(spec, cond, ys[-1], ok,
+                                 sopts=finish_options(opts))
     return ys.at[-1].set(y_fin), ok
 
 
@@ -395,10 +396,18 @@ def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
 
 
 @_lru_cache(maxsize=16)
-def _transient_finish_program(spec: ModelSpec):
+def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions):
     def run(cond, y_last, ok):
-        return transient_finish(spec, cond, y_last, ok)
+        return transient_finish(spec, cond, y_last, ok, sopts=sopts)
     return jax.jit(run)
+
+
+def finish_options(opts: ODEOptions) -> SolverOptions:
+    """SolverOptions for the Newton finish matching an ODEOptions: the
+    finish verdict is judged at the integration's own steady_rel level,
+    so a caller who tightens the transient oracle gets the endpoint
+    judged at the same (not the class-default) tolerance."""
+    return SolverOptions(rate_tol_rel=opts.steady_rel)
 
 
 def chunked_transient_drive(step, finish, conds, y0, save_ts,
@@ -450,7 +459,7 @@ def transient_chunked(spec: ModelSpec, cond: Conditions, save_ts,
     :func:`chunked_transient_drive`). Returns (ys [t, n_s], ok)."""
     return chunked_transient_drive(
         _transient_chunk_program(spec, opts),
-        _transient_finish_program(spec),
+        _transient_finish_program(spec, finish_options(opts)),
         cond, jnp.asarray(cond.y0, dtype=jnp.float64), save_ts, opts,
         chunk, batched=False)
 

@@ -73,9 +73,9 @@ def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
 
 
 @lru_cache(maxsize=16)
-def _transient_finish_program(spec: ModelSpec):
+def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions):
     def fin_one(cond, y_last, ok):
-        return engine.transient_finish(spec, cond, y_last, ok)
+        return engine.transient_finish(spec, cond, y_last, ok, sopts=sopts)
     return jax.jit(jax.vmap(fin_one))
 
 
@@ -83,39 +83,19 @@ def _warn_negative_tof(neg):
     neg = int(neg)
     if neg:
         import warnings
+        # stacklevel=3: _warn_negative_tof <- sweep_steady_state <- user.
         warnings.warn(
             f"sweep_steady_state: net TOF is negative on {neg} lane(s) "
             "(selected steps run in reverse); 'activity' reports the "
             "|TOF| activity for those lanes. Inspect out['tof'] for "
-            "signs.", stacklevel=2)
-
-
-@lru_cache(maxsize=1)
-def _host_callbacks_supported() -> bool:
-    """The tunneled TPU plugin (axon_pjrt) rejects host send/recv
-    callbacks (jax debug/io/pure_callback raise UNIMPLEMENTED)."""
-    try:
-        version = str(getattr(jax.devices()[0].client,
-                              "platform_version", ""))
-    except Exception:
-        return True
-    return "axon" not in version.lower()
+            "signs.", stacklevel=3)
 
 
 @lru_cache(maxsize=16)
 def _tof_program(spec: ModelSpec):
-    with_cb = _host_callbacks_supported()
-
     def batched(conds, ys, mask):
-        tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
+        return jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
                                                                    ys)
-        if with_cb:
-            # Async host callback: surfaces the reversed-TOF warning
-            # without forcing a device sync inside the (timed) sweep
-            # call. Where callbacks are unsupported (axon), callers
-            # read signs from out['tof'] (see sweep_steady_state doc).
-            jax.debug.callback(_warn_negative_tof, jnp.sum(tofs < 0.0))
-        return tofs
     return jax.jit(batched)
 
 
@@ -202,7 +182,7 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
 
     ys, ok = engine.chunked_transient_drive(
         _transient_chunk_program(spec, opts),
-        _transient_finish_program(spec),
+        _transient_finish_program(spec, engine.finish_options(opts)),
         conds, jnp.asarray(conds.y0, dtype=jnp.float64), save_ts, opts,
         chunk, batched=True)
     if n is not None:
@@ -305,8 +285,8 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
 
     Negative net TOF lanes (selected steps running in reverse): the
     'activity' column uses |TOF| (see engine.activity_from_tof); a
-    warning fires via an async host callback where the backend supports
-    callbacks -- otherwise inspect out['tof'] for signs.
+    warning always fires host-side on the materialized TOF vector, and
+    out['tof'] carries the signs.
     """
     # Two-phase solve: a capped single-attempt first pass (sized for the
     # ~p99 lane), then host-side rescue of the failed subset with the
@@ -349,6 +329,12 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
         out["tof"] = tofs
         out["activity"] = engine.activity_from_tof(
             tofs, jax.tree_util.tree_leaves(conds.T)[0])
+        # Deterministic host-side sign check on the materialized TOFs
+        # (NOT an async device callback, which the tunneled axon backend
+        # silently skips): a reverse-running lane must never win a
+        # volcano argmax with no visible signal. The transfer is one
+        # [lanes] float vector -- negligible against the solve.
+        _warn_negative_tof(np.sum(np.asarray(tofs) < 0.0))
     return out
 
 
