@@ -119,26 +119,72 @@ def config_1():
     spec, cond = sim.spec, sim.conditions()
     dyn = np.asarray(spec.dynamic_indices)
 
-    # Timed device solve FIRST, in pristine process state: long mixed
-    # CPU/subprocess phases beforehand degrade per-kernel dispatch
-    # latency on the tunneled TPU runtime ~100x for this small-op
-    # program (measured: identical jitted solve, same 43 iterations,
-    # 0.2 ms early in the process vs 51 ms after the seeding phase).
+    # Timing methodology (round-4 finding): jax.block_until_ready does
+    # NOT synchronize on the tunneled axon backend, and the only honest
+    # fence -- host materialization -- carries the tunnel's ~92 ms
+    # round-trip latency, two orders above the actual device solve.
+    # Three numbers therefore get reported:
+    #   wall_single_ms -- one cold call incl. the tunnel round trip
+    #     (what an interactive user behind THIS tunnel experiences);
+    #   value (ms) -- marginal device time per solve, measured by
+    #     chaining data-dependent solves in one program (each solve's T
+    #     perturbed by the previous solution, so no two solves can
+    #     overlap or be cached) and differencing two chain lengths --
+    #     the framework's own latency, what a co-located host pays;
+    #   rtt_ms -- the measured materialization floor for a trivial
+    #     kernel (pure tunnel overhead, framework-independent).
+    # vs_baseline compares scipy's wall to the marginal device time.
     solve = jax.jit(lambda c: engine.steady_state(spec, c))
-    # Warm up at a shifted temperature: repeated bit-identical
-    # executions can be served from infrastructure-level caches, so
-    # every timed run here uses input values the device has not seen.
-    jax.block_until_ready(solve(cond._replace(T=cond.T + 0.5)).x)
-    reps = 10
-    t0 = time.perf_counter()
-    for i in range(reps):
-        out = solve(cond._replace(T=cond.T + 1.0e-9 * (i + 1)))
-    jax.block_until_ready(out.x)
-    tpu_s = (time.perf_counter() - t0) / reps
-    ok = bool(out.success)
+
+    def chain(c, n):
+        def body(carry, _):
+            T, _x = carry
+            res = engine.steady_state(spec, c._replace(T=T))
+            return (T + res.x[0] * 1e-12 + 1e-9, res.x), res.success
+        (_, x_last), succ = jax.lax.scan(
+            body, (c.T, jnp.zeros(len(spec.snames))), None, length=n)
+        return x_last, succ
+
+    chain1 = jax.jit(lambda c: chain(c, 1))
+    chain25 = jax.jit(lambda c: chain(c, 25))
+    trivial = jax.jit(lambda x: x + 1.0)
+
+    # compile everything (shifted T = fresh values for the timed runs)
+    np.asarray(solve(cond._replace(T=cond.T + 0.5)).x)
+    np.asarray(chain1(cond._replace(T=cond.T + 0.3))[0])
+    np.asarray(chain25(cond._replace(T=cond.T + 0.4))[0])
+    np.asarray(trivial(jnp.zeros(4)))
+
+    def timed(fn, *args):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        ok_all = np.asarray(r[1] if isinstance(r, tuple) else r.success)
+        np.asarray(r[0] if isinstance(r, tuple) else r.x)
+        return time.perf_counter() - t0, ok_all
+
+    rng = np.random.default_rng(4)
+    singles, marginals, rtts = [], [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(trivial(jnp.full(4, rng.uniform())))
+        rtts.append(time.perf_counter() - t0)
+        w1, _ = timed(chain1, cond._replace(T=cond.T + rng.uniform(0, .01)))
+        w25, ok25 = timed(chain25,
+                          cond._replace(T=cond.T + rng.uniform(0, .01)))
+        singles.append(w1)
+        marginals.append((w25 - w1) / 24.0)
+    tpu_s = sorted(marginals)[1]
+    wall_single = sorted(singles)[1]
+    rtt = sorted(rtts)[1]
+    assert bool(np.all(ok25)), "chained solves did not all converge"
+
+    out = solve(cond._replace(T=cond.T + 1.0e-9))
     x_dev = np.asarray(out.x)[dyn]
-    log(f"[1] device steady solve: {tpu_s*1e3:.2f} ms, success={ok}, "
-        f"iters={int(out.iterations)}, attempts={int(out.attempts)}, "
+    ok = bool(out.success)
+    log(f"[1] device steady solve: marginal {tpu_s*1e3:.2f} ms/solve, "
+        f"single call {wall_single*1e3:.1f} ms (tunnel rtt "
+        f"{rtt*1e3:.1f} ms), success={ok}, iters={int(out.iterations)}, "
+        f"attempts={int(out.attempts)}, "
         f"residual={float(out.residual):.3e}")
 
     # Shared seeding step (untimed for either side): integrate the
@@ -237,6 +283,10 @@ np.savez({tail_path!r}, tail=np.asarray(ys[-1]), ok=bool(ok))
 
     return {"config": 1, "metric": "CH4 steady-state solve", "ok": ok,
             "value": round(tpu_s * 1e3, 3), "unit": "ms",
+            "value_min": round(min(marginals) * 1e3, 3),
+            "value_max": round(max(marginals) * 1e3, 3),
+            "wall_single_ms": round(wall_single * 1e3, 2),
+            "rtt_ms": round(rtt * 1e3, 2),
             "vs_baseline": round(scipy_s / tpu_s, 2),
             "seed": "transient",
             "baseline_physical": x_sci is not None,
@@ -250,9 +300,21 @@ np.savez({tail_path!r}, tail=np.asarray(ys[-1]), ok=bool(ok))
 # ----------------------------------------------------------------------
 # config 2: COOxReactor CSTR transient parity
 def config_2():
-    """COOxReactor (Pd111, 523 K) CSTR transient: TR-BDF2 on device vs
-    scipy BDF on the same RHS over the full input time span. Parity =
-    final-state agreement + CO-conversion agreement."""
+    """COOxReactor (Pd111, 523 K) CSTR transient: ESDIRK4 on device vs
+    scipy BDF on the same RHS over the full input time span, at the SAME
+    tolerances (rtol=1e-8/atol=1e-10) -- both are adaptive L-stable
+    high-order implicit families, so this is the apples-to-apples
+    matchup (TR-BDF2, the 2nd-order default, is error-limited here:
+    ~7x the step count at equal tolerance). Parity = final-state
+    agreement + CO-conversion agreement (the endpoint is Newton-landed
+    on the steady attractor, so it holds to ~1e-9 regardless of rtol).
+
+    Timing: median of 3 runs, each at a uniquely jittered T (fresh
+    input values defeat any infrastructure-level result caching) and
+    each timed through full host materialization of the trajectory --
+    jax.block_until_ready does NOT synchronize on the tunneled axon
+    backend (measured: 0.6 ms 'wall' for a 5 s integration), so
+    device->host transfer is the only honest fence."""
     import jax
 
     import pycatkin_tpu as pk
@@ -267,18 +329,21 @@ def config_2():
     save_ts = np.concatenate([[times[0]],
                               np.logspace(-12, np.log10(times[-1]), 40)])
 
-    opts = ODEOptions(rtol=1e-10, atol=1e-12)
+    opts = ODEOptions(rtol=1e-8, atol=1e-10, method="esdirk4")
     run = jax.jit(lambda c: engine.transient(spec, c, save_ts, opts))
-    # warmup at a shifted T (fresh input values for the timed run).
-    jax.block_until_ready(run(cond._replace(T=cond.T + 0.5))[0])
-    t0 = time.perf_counter()
-    ys, ok = run(cond)
-    jax.block_until_ready(ys)
-    tpu_s = time.perf_counter() - t0
-    ys = np.asarray(ys)
+    np.asarray(run(cond._replace(T=cond.T + 0.5))[0])   # compile
+    walls = []
+    # Distinct T per trial (caching hygiene); the LAST runs at exactly
+    # cond.T so the parity check below compares like with like.
+    for dT in (2.0e-8, 1.0e-8, 0.0):
+        t0 = time.perf_counter()
+        ys_i, ok = run(cond._replace(T=cond.T + dT))
+        ys = np.asarray(ys_i)                           # honest fence
+        walls.append(time.perf_counter() - t0)
+    tpu_s = sorted(walls)[1]
+    log(f"[2] device walls: {['%.3f s' % w for w in walls]}")
 
-    # Baseline at the reference's usual tolerances (looser than the
-    # device run above -- favors the baseline).
+    # Baseline at the SAME tolerances as the device run above.
     rhs, y0 = _scipy_rhs(sim, cond)
     from scipy.integrate import solve_ivp
     t0 = time.perf_counter()
@@ -297,11 +362,14 @@ def config_2():
     dconv = abs(x_dev - x_sci)
     parity_ok = bool(bool(ok) and sol.success and dfinal < 1e-5
                      and dconv < 1e-3)
-    log(f"[2] TR-BDF2 {tpu_s*1e3:.1f} ms vs scipy BDF {scipy_s*1e3:.1f} ms; "
+    log(f"[2] ESDIRK4 {tpu_s*1e3:.1f} ms vs scipy BDF {scipy_s*1e3:.1f} ms; "
         f"conversion {x_dev:.3f}% vs {x_sci:.3f}%, max|dy_final|={dfinal:.2e}")
 
     return {"config": 2, "metric": "COOxReactor CSTR transient (parity)",
             "value": round(tpu_s * 1e3, 3), "unit": "ms",
+            "value_min": round(min(walls) * 1e3, 3),
+            "value_max": round(max(walls) * 1e3, 3),
+            "method": "esdirk4",
             "vs_baseline": round(scipy_s / tpu_s, 2),
             "parity_ok": parity_ok,
             "max_final_delta": float(f"{dfinal:.3e}"),
@@ -331,13 +399,19 @@ def config_3():
     # warmup at shifted temperatures (fresh input values when timed).
     warm = sweep_steady_state(spec, conds._replace(T=Ts + 0.25),
                               tof_mask=mask)
-    jax.block_until_ready(warm["y"])
-    t0 = time.perf_counter()
-    out = sweep_steady_state(spec, conds, tof_mask=mask)
-    jax.block_until_ready(out["y"])
-    tpu_s = time.perf_counter() - t0
+    np.asarray(warm["y"])
+    walls, out = [], None
+    for i in range(3):
+        c_i = conds._replace(T=Ts + 1.0e-7 * (i + 1))
+        t0 = time.perf_counter()
+        out = sweep_steady_state(spec, c_i, tof_mask=mask)
+        np.asarray(out["y"])            # honest fence (see config 2)
+        np.asarray(out["activity"])
+        walls.append(time.perf_counter() - t0)
+    tpu_s = sorted(walls)[1]
     n_ok = int(np.sum(np.asarray(out["success"])))
-    log(f"[3] batched sweep: {tpu_s*1e3:.1f} ms for {n_T} temperatures, "
+    log(f"[3] batched sweep walls: {['%.3f s' % w for w in walls]}; "
+        f"median {tpu_s*1e3:.1f} ms for {n_T} temperatures, "
         f"{n_ok}/{n_T} converged")
 
     from scipy.integrate import solve_ivp
@@ -359,6 +433,8 @@ def config_3():
 
     return {"config": 3, "metric": f"DMTM {n_T}-temperature sweep 400-800 K",
             "value": round(n_T / tpu_s, 2), "unit": "temperatures/s",
+            "value_min": round(n_T / max(walls), 2),
+            "value_max": round(n_T / min(walls), 2),
             "vs_baseline": round(scipy_s / tpu_s, 2),
             "converged": f"{n_ok}/{n_T}"}
 
@@ -421,15 +497,21 @@ def config_5():
     t0 = time.perf_counter()
     warm = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
                               tof_mask=mask, opts=opts)
-    jax.block_until_ready(warm["y"])
+    np.asarray(warm["y"])
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts)
-    jax.block_until_ready(out["y"])
-    tpu_s = time.perf_counter() - t0
+    walls, out = [], None
+    for i in range(3):
+        c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1))
+        t0 = time.perf_counter()
+        out = sweep_steady_state(spec, c_i, tof_mask=mask, opts=opts)
+        np.asarray(out["y"])            # honest fence (see config 2)
+        np.asarray(out["activity"])
+        walls.append(time.perf_counter() - t0)
+    tpu_s = sorted(walls)[1]
     n_ok = int(np.sum(np.asarray(out["success"])))
-    log(f"[5] 200x500 batched sweep: {tpu_s:.3f} s for {n} lanes "
-        f"({n_ok}/{n} converged; first run {compile_s:.1f} s)")
+    log(f"[5] 200x500 batched sweep walls: "
+        f"{['%.3f s' % w for w in walls]}; median {tpu_s:.3f} s for {n} "
+        f"lanes ({n_ok}/{n} converged; first run {compile_s:.1f} s)")
 
     # scipy baseline: lm root per lane on the same residual, sampled.
     from scipy.optimize import root
@@ -450,6 +532,8 @@ def config_5():
     return {"config": 5,
             "metric": "synthetic 200x500 stiff network, 8Tx4Px4dE sweep",
             "value": round(n / tpu_s, 2), "unit": "lanes/s",
+            "value_min": round(n / max(walls), 2),
+            "value_max": round(n / min(walls), 2),
             "vs_baseline": round(scipy_s / tpu_s, 2),
             "converged": f"{n_ok}/{n}", "n_dynamic": n_dyn}
 

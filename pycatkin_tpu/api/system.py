@@ -47,11 +47,13 @@ class System:
         self.desorption_model = desorption_model
         # Legacy solver knobs are honored, not silently swallowed
         # (reference old_system.py:154-174):
-        #   ode_solver -- 'trbdf2' is the native integrator; the
-        #     reference schema values 'solve_ivp' and 'ode' (scipy BDF /
-        #     lsoda, old_system.py:350-376) are accepted as aliases of
-        #     it (same stiff integrate-to-steady capability); anything
-        #     else raises.
+        #   ode_solver -- two native L-stable families (mirroring the
+        #     reference's two scipy families, old_system.py:350-376):
+        #     'trbdf2' (2nd order, the default) and 'esdirk4' (4th
+        #     order, the faster choice for accuracy-limited transients
+        #     and the independent cross-check method). The reference
+        #     schema values 'solve_ivp' and 'ode' are accepted as
+        #     aliases of the default; anything else raises.
         #   nsteps -> ODEOptions.max_steps (per-save-interval budget).
         #   ftol/xtol -> SolverOptions.rate_tol: the reference passes
         #     both to least_squares (old_system.py:426-428), which stops
@@ -59,12 +61,12 @@ class System:
         #     residual-based, so the tightest of the two becomes the
         #     absolute residual tolerance (reference inputs ship
         #     non-default xtol, e.g. COOxReactor's 1e-12).
-        if ode_solver not in ("trbdf2", "solve_ivp", "ode"):
+        if ode_solver not in ("trbdf2", "esdirk4", "solve_ivp", "ode"):
             raise ValueError(
                 f"ode_solver={ode_solver!r} is not supported: use "
-                "'trbdf2' (the native TR-BDF2 stiff integrator) or the "
-                "reference-schema aliases 'solve_ivp'/'ode', which map "
-                "onto it.")
+                "'trbdf2' or 'esdirk4' (the native L-stable stiff "
+                "integrators) or the reference-schema aliases "
+                "'solve_ivp'/'ode', which map onto the default.")
         # Legacy-compatible parameter dict (reference old_system.py:154-174);
         # sweep drivers mutate these keys directly.
         self.params = {
@@ -266,6 +268,8 @@ class System:
     def _ode_options(self) -> ODEOptions:
         opts = ODEOptions(rtol=self.params["rtol"],
                           atol=self.params["atol"])
+        if self.params["ode_solver"] == "esdirk4":
+            opts = opts._replace(method="esdirk4")
         # The legacy default (1e4) maps onto the native default budget;
         # an explicitly tuned nsteps becomes the per-interval step cap.
         if int(self.params["nsteps"]) != 10000:

@@ -354,6 +354,22 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     return x_out, success, f_out, iters, attempts
 
 
+def stability_tolerance_from_scale(scale, pos_tol: float = 1e-2,
+                                   eps: float | None = None):
+    """Scale-aware stability threshold from a precomputed max|J|.
+
+    Single source of the formula for BOTH verdict tiers (the on-device
+    Gershgorin certificate feeds device-computed scales; the host eig
+    pass feeds numpy ones) -- tuning the noise-floor constant here
+    cannot desynchronize them. See :func:`stability_tolerance` for the
+    rationale."""
+    import numpy as np
+    scale = np.asarray(scale)
+    if eps is None:
+        eps = np.finfo(scale.dtype).eps
+    return pos_tol + 64.0 * eps * scale
+
+
 def stability_tolerance(jac, pos_tol: float = 1e-2):
     """Effective eigenvalue-stability threshold for a Jacobian (or batch).
 
@@ -369,7 +385,8 @@ def stability_tolerance(jac, pos_tol: float = 1e-2):
     import numpy as np
     jac = np.asarray(jac)
     scale = np.abs(jac).max(axis=(-2, -1))
-    return pos_tol + 64.0 * np.finfo(jac.dtype).eps * scale
+    return stability_tolerance_from_scale(scale, pos_tol,
+                                          np.finfo(jac.dtype).eps)
 
 
 def jacobian_eigenvalues_stable(jac, pos_tol: float = 1e-2):

@@ -1,22 +1,35 @@
-"""Stiff ODE integration: adaptive TR-BDF2 (ESDIRK2(3), L-stable).
+"""Stiff ODE integration: two adaptive L-stable ESDIRK families.
 
 TPU-native replacement for the reference's scipy ``solve_ivp(method='BDF')``
-/ ``ode('lsoda')`` transient path (old_system.py:315-378). Hand-rolled
-because no stiff integrator library ships in this environment; TR-BDF2
-(Hosea & Shampine) is the classic one-step L-stable choice:
+/ ``ode('lsoda')`` transient path (old_system.py:315-378) -- two
+independent on-device methods, mirroring the reference's two scipy
+integrator families:
 
-  stage 1 (TR):   g = y + (gamma*h/2) * (f(y) + f(g))
-  stage 2 (BDF2): y1 = (g - (1-gamma)^2 y) / (gamma*(2-gamma))
-                       + h*(1-gamma)/(2-gamma) * f(y1)
-with gamma = 2 - sqrt(2); both stages share the implicit coefficient
-d = gamma/2, so one LU of (I - d*h*J) serves both stage solves.
+1. ``trbdf2`` -- TR-BDF2 (ESDIRK2(3), Hosea & Shampine), the classic
+   one-step L-stable workhorse and the default:
 
-Embedded 3rd-order error weights give the step controller; the raw error
-is filtered through (I - d*h*J)^-1 for stiff reliability. Everything is
+     stage 1 (TR):   g = y + (gamma*h/2) * (f(y) + f(g))
+     stage 2 (BDF2): y1 = (g - (1-gamma)^2 y) / (gamma*(2-gamma))
+                          + h*(1-gamma)/(2-gamma) * f(y1)
+   with gamma = 2 - sqrt(2); both stages share the implicit coefficient
+   d = gamma/2, so one LU of (I - d*h*J) serves both stage solves.
+
+2. ``esdirk4`` -- ESDIRK4(3)6L[2]SA (Kennedy & Carpenter, NASA
+   TM-2001-211038): six stages (first explicit), stiffly accurate,
+   L-stable, 4th order with an embedded 3rd-order error estimate. All
+   implicit stages share the coefficient d = 1/4, so the SAME frozen
+   factorization serves all five stage solves. At tight tolerances the
+   local error scales h^5 vs TR-BDF2's h^3, cutting step counts ~5-10x
+   on smooth stiff transients (the accepted-step census on the COOx
+   CSTR benchmark showed TR-BDF2 error-limited, not stability-limited,
+   at rtol=1e-10 -- the order barrier, not robustness, set its cost).
+
+Embedded error weights give the step controller; the raw error is
+filtered through (I - d*h*J)^-1 for stiff reliability. Everything is
 ``lax.while_loop``/``scan`` -- jittable, vmappable, differentiable
 (unrolled) -- and integration over huge spans (1e12..1e16 s, the
-reference's integrate-to-steady-state pattern) works because the step size
-grows geometrically once transients die.
+reference's integrate-to-steady-state pattern) works because the step
+size grows geometrically once transients die.
 """
 
 from __future__ import annotations
@@ -41,6 +54,26 @@ BH2 = 1.0 / (6.0 * GAMMA * (1.0 - GAMMA))
 BH3 = 0.5 - GAMMA * BH2
 BH1 = 1.0 - BH2 - BH3
 
+# ESDIRK4(3)6L[2]SA tableau (Kennedy & Carpenter 2001, appendix C;
+# exact rationals). First stage explicit; a_ii = 1/4 for i >= 2;
+# stiffly accurate (b == last row of A), so y1 = z6.
+E4_D = 0.25
+E4_A = (
+    (),                                                    # stage 1
+    (0.25,),                                               # stage 2
+    (8611.0 / 62500.0, -1743.0 / 31250.0),                 # stage 3
+    (5012029.0 / 34652500.0, -654441.0 / 2922500.0,
+     174375.0 / 388108.0),                                 # stage 4
+    (15267082809.0 / 155376265600.0, -71443401.0 / 120774400.0,
+     730878875.0 / 902184768.0, 2285395.0 / 8070912.0),    # stage 5
+    (82889.0 / 524892.0, 0.0, 15625.0 / 83664.0,
+     69875.0 / 102672.0, -2260.0 / 8211.0),                # stage 6
+)
+E4_B = E4_A[5] + (E4_D,)
+E4_BHAT = (4586570599.0 / 29645900160.0, 0.0,
+           178811875.0 / 945068544.0, 814220225.0 / 1159782912.0,
+           -3700637.0 / 11593932.0, 61727.0 / 225920.0)
+
 _NEWTON_ITERS = 6
 
 
@@ -49,6 +82,11 @@ class ODEOptions(NamedTuple):
     atol: float = 1.0e-10
     h0: float = 1.0e-10         # initial step
     max_steps: int = 4000       # per save interval
+    # Integrator family: 'trbdf2' (2nd order, the default) or 'esdirk4'
+    # (4th order, ~5-10x fewer steps at tight tolerances; the
+    # cross-check method and the fast path for accuracy-limited
+    # transients like the CSTR benchmark).
+    method: str = "trbdf2"
     safety: float = 0.9
     min_factor: float = 0.2
     max_factor: float = 8.0
@@ -90,7 +128,7 @@ class ODEOptions(NamedTuple):
     steady_rel: float = 1.0e-9
 
 
-def _stage_solve(f, msolve, z0, rhs_const, h, scale, opts):
+def _stage_solve(f, msolve, z0, rhs_const, h, scale, opts, d=D):
     """Solve z = rhs_const + d*h*f(z) by simplified Newton with the frozen
     factorized iteration matrix (I - d*h*J).
 
@@ -98,10 +136,23 @@ def _stage_solve(f, msolve, z0, rhs_const, h, scale, opts):
     being small relative to the error-control scale -- a silently
     unconverged stage must reject the step, otherwise conservation drifts
     on the huge steps taken near steady state.
+
+    Early exit: iteration stops once the correction falls below 0.03 of
+    the error-control scale (3x tighter than the 0.1 accept threshold,
+    so stage residual contaminates the local-error estimate by at most
+    a few percent of the tolerance band). Most steps converge in 2-3
+    iterations, and the frozen-matrix solve is the cost center of every
+    implicit step, so the saved iterations are pure speedup; hard steps
+    still get the full _NEWTON_ITERS budget. Under vmap the while_loop
+    runs each lane's own count (bounded by the same budget).
     """
-    def body(_, carry):
-        z, _ = carry
-        res = z - rhs_const - D * h * f(z)
+    def cond(carry):
+        z, dz_norm, k = carry
+        return (k < _NEWTON_ITERS) & (dz_norm >= 0.03)
+
+    def body(carry):
+        z, _, k = carry
+        res = z - rhs_const - d * h * f(z)
         dz = msolve(res)
         # Clamp runaway iterates (ODEOptions.clamp/clamp_lo): an
         # overshooting iterate feeds k*prod(y) past the exponent range
@@ -110,9 +161,9 @@ def _stage_solve(f, msolve, z0, rhs_const, h, scale, opts):
         # rejection.
         z_new = jnp.clip(z - dz, opts.clamp_lo, opts.clamp)
         dz_norm = jnp.sqrt(jnp.mean((dz / scale) ** 2))
-        return z_new, dz_norm
-    z, dz_norm = jax.lax.fori_loop(0, _NEWTON_ITERS, body,
-                                   (z0, jnp.asarray(jnp.inf, z0.dtype)))
+        return z_new, dz_norm, k + 1
+    z, dz_norm, _ = jax.lax.while_loop(
+        cond, body, (z0, jnp.asarray(jnp.inf, z0.dtype), 0))
     # A solution pinned on a clamp boundary is not a solution of the
     # stage equations (the clamp truncated it), and one that CONVERGED
     # against the lower bound is a phantom root (see ODEOptions.clamp_lo
@@ -158,6 +209,58 @@ def _trbdf2_step(f, jac, y, t, h, opts: ODEOptions, f0=None):
     return y1, jnp.where(ok, err_ratio, jnp.inf), ok
 
 
+def _esdirk4_step(f, jac, y, t, h, opts: ODEOptions, f0=None):
+    """One ESDIRK4(3)6L[2]SA step attempt. Returns (y_new, err_ratio, ok).
+    ``f0``: f(y) if the caller already evaluated it.
+
+    All five implicit stages share d = 1/4, so one factorization of
+    (I - d*h*J) serves the whole step; stage predictors reuse the
+    accumulated explicit sum. Stiffly accurate: y_new is the last stage,
+    so the scheme is L-stable and needs no separate solution assembly."""
+    n = y.shape[0]
+    eye = jnp.eye(n, dtype=y.dtype)
+    J = jac(y)
+    M = eye - E4_D * h * J
+    msolve = linalg.make_msolve(M)
+
+    if f0 is None:
+        f0 = f(y)
+    scale0 = opts.atol + opts.rtol * jnp.abs(y)
+
+    ks = [f0]
+    conv = jnp.asarray(True)
+    z = y
+    for i in range(1, 6):
+        acc = y
+        for j, a in enumerate(E4_A[i]):
+            if a != 0.0:
+                acc = acc + (a * h) * ks[j]
+        # Predictor: previous stage value (the stages march across the
+        # step, so z_{i-1} is the best cheap estimate of z_i).
+        z, ci = _stage_solve(f, msolve, z, acc, h, scale0, opts, d=E4_D)
+        conv = conv & ci
+        # Stage derivative from the stage equation (exact to the stage
+        # solve's own tolerance): k_i = (z - acc) / (d*h). One f
+        # evaluation per stage saved, and the identity keeps the error
+        # estimate consistent with what the stage actually produced.
+        ks.append((z - acc) / (E4_D * h))
+    y1 = z
+
+    err_raw = h * sum((b - bh) * k
+                      for b, bh, k in zip(E4_B, E4_BHAT, ks))
+    err = msolve(err_raw)
+    scale = opts.atol + opts.rtol * jnp.maximum(jnp.abs(y), jnp.abs(y1))
+    err_ratio = jnp.sqrt(jnp.mean((err / scale) ** 2))
+    ok = (jnp.isfinite(err_ratio) & jnp.all(jnp.isfinite(y1)) & conv)
+    return y1, jnp.where(ok, err_ratio, jnp.inf), ok
+
+
+# Controller exponent: err ~ h^(q+1) with q the embedded order, so the
+# optimal-step factor is err_ratio^(-1/(q+1)).
+_STEP_FNS = {"trbdf2": (_trbdf2_step, 1.0 / 3.0),
+             "esdirk4": (_esdirk4_step, 1.0 / 4.0)}
+
+
 def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
                 steady_fn=None, relax_fn=None):
     """Adaptively integrate from t0 to t1. Returns (y(t1), last_h, ok).
@@ -176,6 +279,10 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
     cross integrate-to-steady tails (1e12..1e16 s) in a few iterations
     while the state keeps evolving (no premature freeze; stage
     convergence is still required)."""
+    if opts.method not in _STEP_FNS:
+        raise ValueError(f"unknown ODE method {opts.method!r}: "
+                         f"use one of {sorted(_STEP_FNS)}")
+    step_fn, ctrl_exp = _STEP_FNS[opts.method]
 
     def cond(state):
         y, t, h, k, ok = state
@@ -213,8 +320,8 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
         steady = oracle | (span_ok & guard)
         h_try = jnp.minimum(h, remaining)
         final = h >= remaining
-        y_new, err_ratio, step_ok = _trbdf2_step(f, jac, y, t, h_try, opts,
-                                                 f0=f0)
+        y_new, err_ratio, step_ok = step_fn(f, jac, y, t, h_try, opts,
+                                            f0=f0)
         relaxed = (relax_fn(y) if relax_fn is not None
                    else jnp.asarray(False))
         # The waiver only covers noise-limited near-steady stepping, so
@@ -232,7 +339,7 @@ def _advance_to(f, jac, y, t0, t1, h_init, opts: ODEOptions,
         accept = step_ok & ((err_ratio <= 1.0) | relaxed)
         factor = jnp.where(
             err_ratio > 0,
-            opts.safety * err_ratio ** (-1.0 / 3.0),
+            opts.safety * err_ratio ** (-ctrl_exp),
             opts.max_factor)
         # jnp.clip propagates NaN: a non-finite factor (overflowed error
         # estimate on TPU's range-limited f64) must read as "shrink",

@@ -127,6 +127,7 @@ def test_steady_state_solves(ch4):
     assert np.all(y[ch4.spec.dynamic_indices] >= -1e-8)
 
 
+@pytest.mark.slow
 def test_steady_root_is_physical(ch4):
     """The default find_steady lands on the PHYSICAL root -- the t->inf
     limit of the start state. The CH4 network is multistable (several

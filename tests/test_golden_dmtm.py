@@ -52,6 +52,7 @@ def test_drc_ranking_over_temperatures(dmtm, tmp_path):
             f"max-DRC step at T={df.iloc[i, 0]} K is not r9"
 
 
+@pytest.mark.slow
 def test_drc_implicit_vs_fd_parity(dmtm):
     """Implicit-function-theorem DRC against reference-parity central
     finite differences on the real DMTM mechanism at 600 and 800 K:

@@ -64,6 +64,7 @@ def test_volcano_point_steady_state_matches_transient(volcano_system):
     assert bool(volcano_system.steady_result.success)
 
 
+@pytest.mark.slow
 def test_volcano_point_drc_implicit_vs_fd(volcano_system):
     """Implicit-vs-FD DRC parity at the golden volcano point: every
     reaction's xi agrees to <=1e-3 and the ID-reactor sum rule holds."""

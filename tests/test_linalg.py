@@ -71,7 +71,8 @@ def test_solve_vmaps():
     np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-11)
 
 
-@pytest.mark.parametrize("n", [5, 48, 49, 190])
+@pytest.mark.parametrize(
+    "n", [5, 48, 49, pytest.param(190, marks=pytest.mark.slow)])
 def test_blocked_lu_matches_plain(n):
     """The statically-unrolled blocked factorization (kept as the
     reference implementation for a future Pallas panel kernel; not in

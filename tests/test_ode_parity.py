@@ -1,11 +1,14 @@
-"""Transient integrator parity: TR-BDF2 vs scipy BDF trajectories.
+"""Transient integrator parity: TR-BDF2 / ESDIRK4 vs scipy BDF.
 
 BASELINE.json config 2 asks for scipy-vs-device integrator parity; the
 golden regressions only pin endpoints. These tests compare FULL
 trajectories on the two reference reactor models (DMTM infinite-dilution,
 COOxReactor CSTR) over a tolerance sweep, using the same numpy RHS for
 scipy that the device path compiles (same rate constants, same reactor
-row transforms -- reference old_system.py:315-383 semantics).
+row transforms -- reference old_system.py:315-383 semantics), for BOTH
+on-device integrator families (the reference likewise ships two scipy
+families, old_system.py:350-376); plus a fixed-step convergence-order
+pin for the ESDIRK4 tableau.
 """
 
 import numpy as np
@@ -44,14 +47,15 @@ def _numpy_rhs(spec, cond):
     return rhs
 
 
-def _trajectories(sim, T, t_end, n_save, rtol, atol):
+def _trajectories(sim, T, t_end, n_save, rtol, atol, method="trbdf2"):
     sim.params["temperature"] = T
     spec, cond = sim.spec, sim.conditions()
     save_ts = np.concatenate([[0.0],
                               np.logspace(-10, np.log10(t_end), n_save)])
     ys, ok = engine.transient(spec, cond, save_ts,
-                              ODEOptions(rtol=rtol, atol=atol))
-    assert bool(ok), "TR-BDF2 did not complete"
+                              ODEOptions(rtol=rtol, atol=atol,
+                                         method=method))
+    assert bool(ok), f"{method} did not complete"
     sol = solve_ivp(_numpy_rhs(spec, cond), (0.0, t_end),
                     np.asarray(cond.y0, dtype=float), method="BDF",
                     t_eval=save_ts, rtol=rtol, atol=atol)
@@ -89,6 +93,53 @@ def test_cstr_trajectory_parity(ref_root, rtol, atol, tol):
     assert dmax < tol, f"trajectory deviation {dmax:.2e} at rtol={rtol}"
 
 
+@pytest.mark.parametrize("rtol,atol,tol", [
+    (1.0e-8, 1.0e-10, 1.0e-4),
+])
+def test_cstr_trajectory_parity_esdirk4(ref_root, rtol, atol, tol):
+    """The 4th-order family tracks scipy BDF through the CSTR transient
+    exactly like the default family does -- the independent cross-check
+    integrator the reference gets from its second scipy family."""
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxReactor", "input_Pd111.json"))
+    ys, ys_ref = _trajectories(sim, 523.0, 3600.0, 25, rtol, atol,
+                               method="esdirk4")
+    dmax = float(np.max(np.abs(ys - ys_ref)))
+    assert dmax < tol, f"trajectory deviation {dmax:.2e} at rtol={rtol}"
+
+
+def test_esdirk4_convergence_order():
+    """Fixed-step convergence on y0' = -2*y0 + y1^2, y1' = -y1 (exact
+    solution y = [(1+t)e^(-2t), e^(-t)]): halving h must cut the error
+    ~16x (4th order). Pins the tableau digits -- a single wrong
+    coefficient degrades the observed order immediately."""
+    import jax
+    import jax.numpy as jnp
+
+    from pycatkin_tpu.solvers import ode as O
+
+    f = lambda y: jnp.array([-2.0 * y[0] + y[1] ** 2, -y[1]])  # noqa: E731
+    jac = jax.jacfwd(f)
+    # Tight scale so the stage-Newton early exit (keyed to the
+    # error-control scale) still iterates the stages to full
+    # convergence; the steps are driven manually, so no rejection path.
+    opts = ODEOptions(rtol=1e-12, atol=1e-14)
+    errs = []
+    for h in (0.1, 0.05, 0.025):
+        y, t = jnp.array([1.0, 1.0]), 0.0
+        while t < 1.0 - 1e-12:
+            hh = min(h, 1.0 - t)
+            y, _, ok = O._esdirk4_step(f, jac, y, t, hh, opts)
+            assert bool(ok)
+            t += hh
+        exact = np.array([2.0 * np.exp(-2.0), np.exp(-1.0)])
+        errs.append(float(np.max(np.abs(np.asarray(y) - exact))))
+    for e_coarse, e_fine in zip(errs, errs[1:]):
+        order = np.log2(e_coarse / e_fine)
+        assert order > 3.5, f"observed order {order:.2f} (errors {errs})"
+
+
+@pytest.mark.slow
 def test_cstr_conversion_endpoint_parity(ref_root):
     """The headline CSTR observable (CO conversion) agrees to 1e-3 %
     between integrators at the golden condition."""

@@ -32,6 +32,7 @@ def volcano(ref_root):
         reference_path("examples", "COOxVolcano", "input.json"))
 
 
+@pytest.mark.slow
 def test_batched_matches_serial(volcano):
     grid = [(-1.0, -1.0), (-1.5, -0.5), (-0.5, -1.5), (-2.0, -1.0)]
     conds = _volcano_conditions(volcano, grid)

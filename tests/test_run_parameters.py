@@ -12,6 +12,7 @@ from pycatkin_tpu.api import presets
 from tests.conftest import reference_path
 
 
+@pytest.mark.slow
 def test_pressure_sweep_dmtm(ref_root, tmp_path):
     """Pressure sweep on DMTM: steady coverages stay conserved at every
     pressure and artifacts carry the swept values."""
@@ -31,6 +32,7 @@ def test_pressure_sweep_dmtm(ref_root, tmp_path):
     assert np.allclose(df.iloc[:, 0].values, pressures)
 
 
+@pytest.mark.slow
 def test_inflow_sweep_cstr(ref_root, tmp_path):
     """Inflow CO partial-pressure sweep on the COOx CSTR: more CO in the
     feed, more CO out; conversion stays finite and physical."""
