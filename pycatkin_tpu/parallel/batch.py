@@ -93,9 +93,16 @@ def _warn_negative_tof(neg):
 
 @lru_cache(maxsize=16)
 def _tof_program(spec: ModelSpec):
+    """One jitted program for (tof, activity, n_negative): everything
+    derived from the solved states in a single dispatch (eager
+    activity_from_tof on [lanes] cost ~1 s of per-op dispatch on the
+    tunneled backend)."""
     def batched(conds, ys, mask):
-        return jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
+        tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
                                                                    ys)
+        act = engine.activity_from_tof(
+            tofs, jax.tree_util.tree_leaves(conds.T)[0])
+        return tofs, act, jnp.sum(tofs < 0.0)
     return jax.jit(batched)
 
 
@@ -201,15 +208,18 @@ def _jacobian_program(spec: ModelSpec):
 
 
 @lru_cache(maxsize=16)
-def _stability_screen_program(spec: ModelSpec):
-    """Vmapped device-side Gershgorin stability certificate.
+def _stability_screen_program(spec: ModelSpec, pos_tol: float):
+    """Device-side Gershgorin stability certificate + verdict assembly.
 
     For any (real or complex) eigenvalue of J, Re(lambda) is bounded by
     the Gershgorin row bound max_i(J_ii + sum_{j!=i}|J_ij|), and -- via
-    J^T having the same spectrum -- by the column bound. Per lane this
-    returns (bound, scale, finite): bound = min(row, column) upper bound
-    on max Re(lambda), scale = max|J| (feeds the scale-aware noise
-    floor, solvers.newton.stability_tolerance).
+    J^T having the same spectrum -- by the column bound. The per-lane
+    bound, the scale-aware threshold
+    (solvers.newton.stability_tolerance_from_scale on max|J|) and the
+    certified/ambiguous combination ALL live in this one jitted program
+    (eager per-op dispatch is expensive on the tunneled backend), so
+    one call returns (certified [lanes], ambiguous [lanes],
+    n_ambiguous scalar).
 
     The certificate is SOUND one-way: bound <= tol proves stability;
     bound > tol proves nothing (Gershgorin is not tight). Microkinetic
@@ -218,6 +228,8 @@ def _stability_screen_program(spec: ModelSpec):
     so the COLUMN bound typically sits at ~0 and certifies the vast
     majority of converged lanes on-device; only the ambiguous rest pays
     a host nonsymmetric-eig solve (XLA has none on TPU)."""
+    from ..solvers.newton import stability_tolerance_from_scale
+
     dyn = jnp.asarray(spec.dynamic_indices)
 
     def screen_one(cond, y):
@@ -231,51 +243,75 @@ def _stability_screen_program(spec: ModelSpec):
         finite = jnp.all(jnp.isfinite(J))
         return bound, scale, finite
 
-    return jax.jit(jax.vmap(screen_one))
+    def batched(conds, ys, ok):
+        bound, scale, finite = jax.vmap(screen_one)(conds, ys)
+        tol = stability_tolerance_from_scale(scale, pos_tol)
+        good = finite & ok
+        certified = good & (bound <= tol)
+        ambiguous = good & ~certified
+        return certified, ambiguous, jnp.sum(ambiguous)
+
+    return jax.jit(batched)
+
+
+def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
+                   bucket: int = 64):
+    """Gather lanes ``idx`` of a Conditions pytree (plus companion
+    arrays), padded with repeats of idx[0] to a ``bucket`` multiple:
+    vmapped programs compile per subset SHAPE, and variable counts
+    would otherwise pay a fresh multi-second XLA compile each time
+    (shared by the rescue passes and the stability tier 2)."""
+    n_pad = -len(idx) % bucket
+    idx_p = np.concatenate([idx, np.repeat(idx[:1], n_pad)])
+    sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx_p], conds)
+    return (sub, idx_p) + tuple(jnp.asarray(a)[idx_p] for a in arrays)
 
 
 def stability_mask(spec: ModelSpec, conds: Conditions, ys,
-                   pos_tol: float = 1e-2, ok=None) -> np.ndarray:
+                   pos_tol: float = 1e-2, ok=None) -> jnp.ndarray:
     """[lanes] Jacobian-eigenvalue stability verdict (reference
     solver.py:102-106) for batched steady solutions, two-tier:
 
-    1. On-device Gershgorin certificate (one vmapped program returning
-       three scalars per lane -- no [lanes, n, n] transfer): lanes whose
-       certified bound on max Re(lambda) clears the scale-aware
-       threshold are stable, full stop.
+    1. On-device Gershgorin certificate: lanes whose certified bound on
+       max Re(lambda) clears the scale-aware threshold are stable, full
+       stop. The certificate, threshold AND combination stay on device;
+       the only mandatory host traffic is ONE scalar (the ambiguous
+       count) -- on the tunneled backend every device->host
+       materialization call costs ~0.8-1.2 s of round trip regardless
+       of size (measured round 4), so per-lane arrays cross only when
+       tier 2 actually runs.
     2. Host ``numpy.linalg.eigvals`` on the AMBIGUOUS subset only (the
        certificate is one-sided; XLA ships no nonsymmetric eig on TPU).
 
-    Both tiers use :func:`solvers.newton.stability_tolerance`, so the
-    verdict matches the all-host implementation exactly on lanes where
-    the certificate abstains, and can only differ by declaring a lane
-    stable that the host eig ALSO declares stable (the bound majorizes
-    max Re(lambda)).
+    Both tiers use the :func:`solvers.newton.stability_tolerance_from_scale`
+    formula, so the verdict matches the all-host implementation exactly
+    on lanes where the certificate abstains, and can only differ by
+    declaring a lane stable that the host eig ALSO declares stable (the
+    bound majorizes max Re(lambda)).
 
     ``ok``: optional [lanes] convergence mask -- non-converged or
     non-finite lanes are reported unstable without entering the
-    eigenvalue solve (numpy eig raises on non-finite input, and failed
-    lanes may hold divergent iterates)."""
-    from ..solvers.newton import (stability_tolerance,
-                                  stability_tolerance_from_scale)
+    eigenvalue solve. Returns a DEVICE bool array.
+    """
+    from ..solvers.newton import stability_tolerance
     ys = jnp.asarray(ys)
-    bound, scale, finite = _stability_screen_program(spec)(conds, ys)
-    bound = np.asarray(bound)
-    scale = np.asarray(scale)
-    good = np.asarray(finite).astype(bool)
-    if ok is not None:
-        good &= np.asarray(ok).astype(bool)
-    tol = stability_tolerance_from_scale(scale, pos_tol)
-    out = good & (bound <= tol)
-    ambiguous = good & ~out
-    if ambiguous.any():
-        idx = np.flatnonzero(ambiguous)
-        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
-        Js = np.asarray(_jacobian_program(spec)(sub, ys[idx]))
+    n = ys.shape[0]
+    ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
+              else jnp.ones(n, dtype=bool))
+    certified, ambiguous, n_amb_dev = _stability_screen_program(
+        spec, pos_tol)(conds, ys, ok_dev)
+    n_amb = int(np.asarray(n_amb_dev))               # scalar round trip
+    if n_amb:
+        idx = np.flatnonzero(np.asarray(ambiguous))
+        sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,))
+        Js = np.asarray(_jacobian_program(spec)(sub, ys_p))[:len(idx)]
         eig = np.linalg.eigvals(Js)
         tol_sub = stability_tolerance(Js, pos_tol)
-        out[idx] = np.all(eig.real <= tol_sub[..., None], axis=-1)
-    return out
+        host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
+        out = np.array(certified)    # writable host copy
+        out[idx] = host_ok
+        return jnp.asarray(out)
+    return certified
 
 
 def _rescue(spec: ModelSpec, conds: Conditions, res,
@@ -292,13 +328,15 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     instead of each lane's best iterate -- required when the iterate
     itself is the problem (a converged-but-UNSTABLE root: re-seeding on
     it would reconverge with zero residual immediately)."""
-    fail = ~np.asarray(res.success)
-    if not fail.any():
+    # Scalar pre-check first: on the tunneled backend every
+    # materialization call costs ~0.8-1.2 s regardless of payload, so
+    # the full mask crosses to the host only when lanes actually failed
+    # (the common volcano case is zero failures -> one cheap scalar).
+    if int(np.asarray(jnp.sum(~jnp.asarray(res.success)))) == 0:
         return res
+    fail = ~np.asarray(res.success)
     idx = np.flatnonzero(fail)
-    n_pad = -len(idx) % pad_to
-    idx_p = np.concatenate([idx, np.repeat(idx[:1], n_pad)])
-    sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx_p], conds)
+    sub, idx_p = _padded_subset(conds, idx, bucket=pad_to)
     x0 = (jnp.asarray(res.x)[idx_p][:, jnp.asarray(spec.dynamic_indices)]
           if use_x0 else None)
     keys = jax.random.split(jax.random.PRNGKey(seed), len(idx_p))
@@ -349,11 +387,14 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     fast = opts._replace(max_steps=min(opts.max_steps, 100),
                          max_attempts=1)
     res = batch_steady_state(spec, conds, x0=x0, opts=fast, mesh=mesh)
-    res = _rescue(spec, conds, res, opts, "ptc")
-    res = _rescue(spec, conds, res, opts, "lm")
+    # One scalar round trip decides both rescue phases (each
+    # materialization call costs ~0.1-1 s on the tunneled backend).
+    if int(np.asarray(jnp.sum(~jnp.asarray(res.success)))) > 0:
+        res = _rescue(spec, conds, res, opts, "ptc")
+        res = _rescue(spec, conds, res, opts, "lm")
     if check_stability:
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
-                                ok=np.asarray(res.success))
+                                ok=res.success)
         # Converged-but-UNSTABLE lanes (e.g. the middle root of a
         # bistable mechanism) get the facade's random-restart treatment
         # (api/system.py find_steady: up to 3 retries from fresh
@@ -361,17 +402,19 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
         # re-solve WITHOUT their poisoned iterate (restarting on an
         # unstable root reconverges to it with zero residual), and
         # re-judge. Reference solver.py:102-120 verdict-and-retry.
+        # The demote decision crosses to the host as one scalar per
+        # round (see stability_mask on materialization-call cost).
         for round_i in range(3):
-            demoted = np.asarray(res.success) & ~stable
-            if not demoted.any():
+            demoted = jnp.asarray(res.success) & ~stable
+            if int(np.asarray(jnp.sum(demoted))) == 0:
                 break
-            res = res._replace(success=jnp.asarray(
-                np.asarray(res.success) & stable))
+            res = res._replace(
+                success=jnp.asarray(res.success) & stable)
             res = _rescue(spec, conds, res, opts, "ptc",
                           seed=17 + round_i, use_x0=False)
             stable = stability_mask(spec, conds, res.x,
                                     pos_tol=pos_jac_tol,
-                                    ok=np.asarray(res.success))
+                                    ok=res.success)
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts}
     if check_stability:
@@ -379,16 +422,15 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
                                          jnp.asarray(stable))
     if tof_mask is not None:
-        tofs = _tof_program(spec)(conds, res.x, jnp.asarray(tof_mask))
+        tofs, act, n_neg = _tof_program(spec)(conds, res.x,
+                                              jnp.asarray(tof_mask))
         out["tof"] = tofs
-        out["activity"] = engine.activity_from_tof(
-            tofs, jax.tree_util.tree_leaves(conds.T)[0])
-        # Deterministic host-side sign check on the materialized TOFs
-        # (NOT an async device callback, which the tunneled axon backend
-        # silently skips): a reverse-running lane must never win a
-        # volcano argmax with no visible signal. The transfer is one
-        # [lanes] float vector -- negligible against the solve.
-        _warn_negative_tof(np.sum(np.asarray(tofs) < 0.0))
+        out["activity"] = act
+        # Deterministic host-side sign check (NOT an async device
+        # callback, which the tunneled axon backend silently skips): a
+        # reverse-running lane must never win a volcano argmax with no
+        # visible signal. Reduced on device; one scalar crosses.
+        _warn_negative_tof(np.asarray(n_neg))
     return out
 
 

@@ -1,0 +1,159 @@
+"""DCN-tier sweep dispatcher: split a lane grid across processes.
+
+SURVEY.md §5.8's outer parallelism tier: descriptor/condition lanes are
+physically independent, so beyond one mesh (vmap + shard_map over ICI)
+the next axis is *embarrassingly parallel dispatch* of disjoint lane
+blocks to independent workers -- separate processes on one host, or
+separate hosts/slices connected only by DCN. No collective runs between
+blocks; the only "communication" is the result merge, exactly the
+structure the reference's serial sweep loops imply (grid points couple
+nowhere in the math -- the one neighbor coupling, grid-repair
+averaging, is post-hoc host-side).
+
+Protocol (all host-side, no JAX in the parent):
+  1. the mechanism is serialized once (utils.io.save_system_json --
+     the reference-schema JSON round-trip);
+  2. the lane-batched Conditions pytree is split into contiguous
+     blocks, one .npz per worker;
+  3. each worker is a fresh ``python -m pycatkin_tpu.parallel.dispatch``
+     process: loads the JSON, rebuilds the spec, runs
+     ``sweep_steady_state`` on its block, writes results to .npz;
+  4. the parent waits and concatenates blocks in lane order.
+
+Workers inherit the parent environment by default; pass ``worker_env``
+overrides to pin devices (e.g. one TPU slice per worker via
+``JAX_PLATFORMS`` / topology env vars, or ``JAX_PLATFORMS=cpu`` for
+host-only workers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+import numpy as np
+
+def save_conditions(path: str, conds) -> None:
+    """Write a (lane-batched) Conditions pytree to .npz (the namedtuple's
+    own field list, so a schema change round-trips automatically)."""
+    np.savez_compressed(
+        path, **{f: np.asarray(getattr(conds, f)) for f in conds._fields})
+
+
+def load_conditions(path: str):
+    """Read a Conditions pytree written by :func:`save_conditions`."""
+    from ..frontend.spec import Conditions
+    with np.load(path) as z:
+        return Conditions(**{f: z[f] for f in Conditions._fields})
+
+
+def _split_slices(n: int, k: int):
+    """k contiguous, near-equal [start, stop) blocks covering range(n)."""
+    bounds = np.linspace(0, n, k + 1).astype(int)
+    return [(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])
+            if b > a]
+
+
+def dispatch_sweep(sim, conds, n_workers: int = 2,
+                   work_dir: Optional[str] = None,
+                   tof_terms=None, check_stability: bool = False,
+                   worker_env: Optional[dict] = None,
+                   timeout: Optional[float] = None) -> dict:
+    """Run ``sweep_steady_state`` over ``conds`` split across
+    ``n_workers`` independent processes; returns the merged result dict
+    (same keys as the in-process sweep, lane order preserved).
+
+    ``sim``: a built System (serialized to JSON for the workers).
+    ``conds``: lane-batched Conditions.
+    """
+    import tempfile
+
+    from ..utils.io import save_system_json
+
+    own_dir = work_dir is None
+    if own_dir:
+        work_dir = tempfile.mkdtemp(prefix="pycatkin_dispatch_")
+    os.makedirs(work_dir, exist_ok=True)
+
+    model_path = os.path.join(work_dir, "model.json")
+    save_system_json(sim, model_path)
+
+    n = len(np.asarray(conds.T))
+    blocks = _split_slices(n, n_workers)
+    procs = []
+    for i, (a, b) in enumerate(blocks):
+        block = type(conds)(**{
+            f: np.asarray(getattr(conds, f))[a:b] for f in conds._fields})
+        in_path = os.path.join(work_dir, f"block_{i}.npz")
+        out_path = os.path.join(work_dir, f"result_{i}.npz")
+        save_conditions(in_path, block)
+        cfg = {"model": model_path, "conds": in_path, "out": out_path,
+               "tof_terms": list(tof_terms) if tof_terms else None,
+               "check_stability": bool(check_stability)}
+        cfg_path = os.path.join(work_dir, f"job_{i}.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        env = dict(os.environ)
+        if worker_env:
+            env.update({k: str(v) for k, v in worker_env.items()})
+        procs.append((i, out_path, subprocess.Popen(
+            [sys.executable, "-m", "pycatkin_tpu.parallel.dispatch",
+             cfg_path],
+            env=env, cwd=os.getcwd())))
+
+    failed = []
+    try:
+        for i, out_path, p in procs:
+            rc = p.wait(timeout=timeout)
+            if rc != 0 or not os.path.exists(out_path):
+                failed.append(i)
+    finally:
+        # Never orphan workers: on timeout/failure/interrupt, terminate
+        # whatever is still running before propagating.
+        for _, _, p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    if failed:
+        raise RuntimeError(
+            f"dispatch_sweep: worker block(s) {failed} failed; inputs "
+            f"and any partial results are in {work_dir}")
+
+    merged: dict = {}
+    for i, out_path, _ in procs:
+        with np.load(out_path) as z:
+            for key in z.files:
+                merged.setdefault(key, []).append(z[key])
+    out = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+    if own_dir:
+        # Self-created scratch only; caller-supplied work_dirs (and any
+        # failure, which raises above) are left in place for debugging.
+        import shutil
+        shutil.rmtree(work_dir, ignore_errors=True)
+    return out
+
+
+def _worker(cfg_path: str) -> None:
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+
+    import pycatkin_tpu as pk
+    from .. import engine
+    from .batch import sweep_steady_state
+
+    sim = pk.read_from_input_file(cfg["model"])
+    conds = load_conditions(cfg["conds"])
+    mask = (engine.tof_mask_for(sim.spec, cfg["tof_terms"])
+            if cfg.get("tof_terms") else None)
+    out = sweep_steady_state(sim.spec, conds, tof_mask=mask,
+                             check_stability=cfg.get("check_stability",
+                                                     False))
+    np.savez_compressed(cfg["out"],
+                        **{k: np.asarray(v) for k, v in out.items()})
+
+
+if __name__ == "__main__":
+    _worker(sys.argv[1])

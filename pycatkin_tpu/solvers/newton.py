@@ -98,6 +98,19 @@ def _rnorm(F, gross, opts: SolverOptions):
     return jnp.max(jnp.abs(F) / (opts.rate_tol + opts.rate_tol_rel * gross))
 
 
+def _direction_solve(A, b):
+    """Newton/PTC direction solve (one site for future kernel swaps).
+
+    Stays on the full-precision arithmetic kernels everywhere. The
+    round-4 mixed-precision experiments are recorded in
+    docs/perf_config5.md: XLA:TPU's native f32 LuDecomposition custom
+    call crashes the TPU worker when invoked inside a vmapped
+    while_loop, and an f32 statically-blocked factorization compiled
+    93 s, ran 5x slower than the emulated-f64 kernels, and lost the
+    refinement contraction on hard row-scaled matrices."""
+    return linalg.solve(A, b)
+
+
 def conservation_constraints(groups_dyn):
     """Row-replacement operators for the conservation constraints.
 
@@ -144,7 +157,7 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         x, F, dt, fnorm, k = state
         J = jac_fn(x)
         A = jnp.where(M[:, None] > 0, R, eye / dt - J)
-        dx = linalg.solve(A, F * (1.0 - M))
+        dx = _direction_solve(A, F * (1.0 - M))
         # Projected PTC: clamp nonnegative AND renormalize conservation
         # groups (reference min_tol flooring + _normalize_y semantics,
         # system.py:305-328). Negative coverages flip rate signs and
@@ -259,7 +272,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         dmax = jnp.maximum(jnp.max(jnp.diag(JtJ)), 1e-300)
         A = jnp.where(M[:, None] > 0, R, JtJ + (lam * dmax) * eye)
         g = jnp.where(M > 0, 0.0, J.T @ (F / scale))
-        dx = linalg.solve(A, -g * (1.0 - M))
+        dx = _direction_solve(A, -g * (1.0 - M))
         x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
                            opts.floor)
         F_new, gross_new = fscale_fn(x_new)
@@ -309,6 +322,25 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     Returns (x, success, normalized_residual, iterations, attempts).
     """
     attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
+    if opts.max_attempts == 1:
+        # Dedicated single-attempt path (the batched sweep's capped
+        # first pass): no retry while_loop, no PRNG restart machinery,
+        # no multi-attempt scoreboard -- a measurably smaller compiled
+        # program (every emulated-f64 op instance costs ~10-20 ms of
+        # TPU compile; the volcano-scale program is compile-bound).
+        # Semantics match the general path at max_attempts=1 exactly:
+        # attempt 0 starts from the caller's guess verbatim, and the
+        # lexicographic scoreboard degenerates to best-of {x0, x1}.
+        F0, gross0 = fscale_fn(x0)
+        f0 = _rnorm(F0, gross0, opts)
+        x1, f1, k = attempt_fn(fscale_fn, jac_fn, x0, groups_dyn, opts)
+        ok = _verdict(x1, f1, groups_dyn, opts)
+        better = _score(x1, f1, groups_dyn, opts) > _score(x0, f0,
+                                                          groups_dyn,
+                                                          opts)
+        x_out = jnp.where(ok | better, x1, x0)
+        f_out = jnp.where(ok | better, f1, f0)
+        return x_out, ok, f_out, k, jnp.asarray(1)
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -361,12 +393,13 @@ def stability_tolerance_from_scale(scale, pos_tol: float = 1e-2,
     Single source of the formula for BOTH verdict tiers (the on-device
     Gershgorin certificate feeds device-computed scales; the host eig
     pass feeds numpy ones) -- tuning the noise-floor constant here
-    cannot desynchronize them. See :func:`stability_tolerance` for the
-    rationale."""
+    cannot desynchronize them. Accepts numpy OR jax arrays without
+    forcing a transfer (eps is read from the dtype, the arithmetic
+    stays in the input's array namespace). See
+    :func:`stability_tolerance` for the rationale."""
     import numpy as np
-    scale = np.asarray(scale)
     if eps is None:
-        eps = np.finfo(scale.dtype).eps
+        eps = float(np.finfo(getattr(scale, "dtype", np.float64)).eps)
     return pos_tol + 64.0 * eps * scale
 
 

@@ -1,0 +1,74 @@
+"""DCN-tier dispatcher: multi-process sweep split + merge
+(parallel/dispatch.py; SURVEY.md §5.8 outer parallelism tier).
+
+The two-worker demo splits a small COOx volcano block across two
+independent OS processes (each rebuilding the mechanism from the JSON
+round-trip and running its own batched device program), merges the
+.npz results, and checks the merge agrees lane-for-lane with the
+single-process sweep -- plus grid triage running on the merged output.
+"""
+
+import numpy as np
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.models import coox
+from pycatkin_tpu.parallel.batch import sweep_steady_state
+from pycatkin_tpu.parallel.dispatch import (_split_slices, dispatch_sweep,
+                                            load_conditions,
+                                            save_conditions)
+from tests.conftest import reference_path
+
+
+def test_split_slices_cover_and_order():
+    assert _split_slices(10, 3) == [(0, 3), (3, 6), (6, 10)]
+    assert _split_slices(2, 4) == [(0, 1), (1, 2)]
+    assert _split_slices(8, 2) == [(0, 4), (4, 8)]
+
+
+def test_conditions_npz_roundtrip(ref_root, tmp_path):
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+    be = np.linspace(-2.0, 0.0, 3)
+    conds, _ = coox.volcano_grid_conditions(sim, be)
+    path = str(tmp_path / "conds.npz")
+    save_conditions(path, conds)
+    back = load_conditions(path)
+    for f in conds._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(conds, f)),
+                                      np.asarray(getattr(back, f)))
+
+
+@pytest.mark.slow
+def test_two_process_dispatch_matches_in_process(ref_root, tmp_path):
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+    be = np.linspace(-2.5, 0.5, 4)
+    conds, shape = coox.volcano_grid_conditions(sim, be)
+
+    merged = dispatch_sweep(
+        sim, conds, n_workers=2, work_dir=str(tmp_path),
+        tof_terms=["CO_ox"],
+        worker_env={"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+
+    ref = sweep_steady_state(sim.spec, conds,
+                             tof_mask=engine.tof_mask_for(sim.spec,
+                                                          ["CO_ox"]))
+    assert merged["y"].shape == np.asarray(ref["y"]).shape
+    assert np.array_equal(merged["success"],
+                          np.asarray(ref["success"]))
+    np.testing.assert_allclose(merged["y"], np.asarray(ref["y"]),
+                               rtol=1e-7, atol=1e-10)
+    np.testing.assert_allclose(merged["activity"],
+                               np.asarray(ref["activity"]),
+                               rtol=1e-7, atol=1e-9)
+
+    # grid triage runs on the merged output exactly as on in-process
+    # results (the dispatcher is invisible downstream).
+    from pycatkin_tpu.analysis.grid import average_neighborhood
+    act = merged["activity"].reshape(shape)
+    ok = merged["success"].reshape(shape)
+    patched, patched_mask = average_neighborhood(act, ok)
+    assert patched.shape == shape
+    assert int(patched_mask.sum()) == int((~ok).sum())

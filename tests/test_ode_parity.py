@@ -120,9 +120,9 @@ def test_esdirk4_convergence_order():
 
     f = lambda y: jnp.array([-2.0 * y[0] + y[1] ** 2, -y[1]])  # noqa: E731
     jac = jax.jacfwd(f)
-    # Tight scale so the stage-Newton early exit (keyed to the
-    # error-control scale) still iterates the stages to full
-    # convergence; the steps are driven manually, so no rejection path.
+    # Tight scale: stage-solve accuracy must sit far below the
+    # truncation errors being measured (steps are driven manually, so
+    # the rejection path never runs).
     opts = ODEOptions(rtol=1e-12, atol=1e-14)
     errs = []
     for h in (0.1, 0.05, 0.025):

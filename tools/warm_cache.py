@@ -1,0 +1,90 @@
+"""Pre-compile the hot batched programs into the persistent XLA cache.
+
+Cold-compile economics on TPU (measured, docs/perf_config5.md §6):
+every emulated-f64 op instance costs ~10-20 ms of XLA compile and each
+transcendental ~0.35 s, so the volcano-scale batched solve costs tens
+of seconds the first time on a machine. The persistent cache
+(utils/cache.py) makes every later process load the compiled
+executable from disk instead; this tool front-loads that cost once --
+run it after install, after a JAX upgrade, or in an image build:
+
+    python tools/warm_cache.py [grid_n]
+
+Programs warmed: the capped first-pass sweep program at the full
+[grid_n^2] lane shape, its rescue programs (full-ladder PTC + LM at
+the 64-lane bucket), the stability screen, the subset Jacobian
+program, and the TOF/activity program -- the complete
+sweep_steady_state surface for the flagship workload.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+
+cache_dir = enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import pycatkin_tpu as pk
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.models import coox
+    from pycatkin_tpu.parallel import batch
+
+    grid_n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    ref = os.environ.get(
+        "PYCATKIN_REFERENCE_INPUT",
+        "/root/reference/examples/COOxVolcano/input.json")
+    print(f"cache: {cache_dir if cache_dir else 'disabled (cpu)'}")
+
+    sim = pk.read_from_input_file(ref)
+    spec = sim.spec
+    be = np.linspace(-2.5, 0.5, grid_n)
+    conds, _ = coox.volcano_grid_conditions(sim, be)
+    mask = engine.tof_mask_for(spec, ["CO_ox"])
+    n = grid_n * grid_n
+
+    from pycatkin_tpu.solvers.newton import SolverOptions
+    opts = SolverOptions()
+    t0 = time.perf_counter()
+    # Main sweep surface (first pass + screen + tof/activity).
+    out = batch.sweep_steady_state(spec, conds, tof_mask=mask,
+                                   check_stability=True)
+    np.asarray(out["y"])
+    print(f"sweep programs: {time.perf_counter() - t0:.1f} s")
+
+    # Rescue programs at the 64-lane bucket (compiled lazily only when
+    # lanes fail; warm them explicitly so a hard grid's first failure
+    # doesn't pay the compile).
+    t0 = time.perf_counter()
+    sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[:64], conds)
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+    x0 = jnp.asarray(out["y"])[:64][:, jnp.asarray(spec.dynamic_indices)]
+    for strat in ("ptc", "lm"):
+        r = batch._steady_program(spec, opts, strategy=strat)(sub, keys,
+                                                              x0)
+        np.asarray(r.residual)
+    # The stability demote loop rescues with use_x0=False -> x0=None,
+    # which traces a DIFFERENT program than the x0-array variant above.
+    r = batch._steady_program(spec, opts, strategy="ptc")(sub, keys, None)
+    np.asarray(r.residual)
+    # Subset Jacobian program (stability tier 2) at the same bucket.
+    np.asarray(batch._jacobian_program(spec)(sub,
+                                             jnp.asarray(out["y"])[:64]))
+    print(f"rescue + tier-2 programs: {time.perf_counter() - t0:.1f} s")
+    print(f"warm: a fresh process now loads all {n}-lane volcano "
+          "programs from the persistent cache.")
+
+
+if __name__ == "__main__":
+    main()
