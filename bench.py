@@ -30,6 +30,24 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def result_fence():
+    """One-scalar timing fence over a sweep result (shared by bench.py
+    and bench_suite.py so its guarantees cannot drift apart): the
+    returned jitted function reduces y + finite activities + success
+    flags to ONE scalar whose value depends on every output, so a
+    single materialization (one tunnel round trip) forces the whole
+    program chain to execute with nothing hidden."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fence(y, activity, success):
+        act = jnp.where(jnp.isfinite(activity), activity, 0.0)
+        return jnp.sum(y) + jnp.sum(act) + jnp.sum(success)
+
+    return fence
+
+
 def scipy_baseline_seconds_per_point(sim, sample_points):
     """Reference-style per-point solve: scipy BDF transient to the input
     time span, TOF at the final state (test_2.py workflow). Rate-constant
@@ -147,13 +165,7 @@ def main():
     # scalar still forces the whole program chain to execute (its value
     # depends on every y and every activity), so nothing can hide; the
     # full result arrays cross AFTER the clock stops.
-    import jax.numpy as jnp
-
-    @jax.jit
-    def checksum(y, activity, success):
-        act = jnp.where(jnp.isfinite(activity), activity, 0.0)
-        return jnp.sum(y) + jnp.sum(act) + jnp.sum(success)
-
+    checksum = result_fence()
     # compile the fence program outside the timed region
     np.asarray(checksum(warm_out["y"], warm_out["activity"],
                         warm_out["success"]))

@@ -408,17 +408,18 @@ def config_3():
     warm = sweep_steady_state(spec, conds._replace(T=Ts + 0.25),
                               tof_mask=mask)
     np.asarray(warm["y"])
-    import jax.numpy as jnp
-    fence = jax.jit(lambda y, a: jnp.sum(y) +
-                    jnp.sum(jnp.where(jnp.isfinite(a), a, 0.0)))
-    np.asarray(fence(warm["y"], warm["activity"]))   # compile untimed
+    from bench import result_fence
+    fence = result_fence()
+    np.asarray(fence(warm["y"], warm["activity"],
+                     warm["success"]))               # compile untimed
     walls, out = [], None
     for i in range(3):
         c_i = conds._replace(T=Ts + 1.0e-7 * (i + 1))
         t0 = time.perf_counter()
         out = sweep_steady_state(spec, c_i, tof_mask=mask)
         # one-scalar fence = one tunnel round trip (see config 2)
-        float(np.asarray(fence(out["y"], out["activity"])))
+        float(np.asarray(fence(out["y"], out["activity"],
+                               out["success"])))
         walls.append(time.perf_counter() - t0)
     tpu_s = sorted(walls)[1]
     n_ok = int(np.sum(np.asarray(out["success"])))
@@ -505,23 +506,30 @@ def config_5():
     conds = broadcast_conditions(base, n)._replace(
         T=TT.ravel(), p=PP.ravel(), eps=eps)
     mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
-
+    # Plain batched sweep. Warm-started continuation along T
+    # (parallel.batch.continuation_sweep) was measured HERE at 41.7
+    # lanes/s vs 46.8 plain: stage iterations drop 14.4 -> ~3.5 as
+    # designed, but 16-lane stages underutilize the chip (a [16, 190,
+    # 190] iteration costs ~40% of a [128, ...] one), so ~42 small
+    # sequential iteration-steps lose to 18 big SIMD ones. The feature
+    # pays when stages carry >= ~100 lanes (docs/perf_config5.md §8).
     t0 = time.perf_counter()
     warm = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
                               tof_mask=mask, opts=opts)
     np.asarray(warm["y"])
     compile_s = time.perf_counter() - t0
-    import jax.numpy as jnp
-    fence = jax.jit(lambda y, a: jnp.sum(y) +
-                    jnp.sum(jnp.where(jnp.isfinite(a), a, 0.0)))
-    np.asarray(fence(warm["y"], warm["activity"]))   # compile untimed
+    from bench import result_fence
+    fence = result_fence()
+    np.asarray(fence(warm["y"], warm["activity"],
+                     warm["success"]))               # compile untimed
     walls, out = [], None
     for i in range(3):
         c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1))
         t0 = time.perf_counter()
         out = sweep_steady_state(spec, c_i, tof_mask=mask, opts=opts)
         # one-scalar fence = one tunnel round trip (see config 2)
-        float(np.asarray(fence(out["y"], out["activity"])))
+        float(np.asarray(fence(out["y"], out["activity"],
+                               out["success"])))
         walls.append(time.perf_counter() - t0)
     tpu_s = sorted(walls)[1]
     n_ok = int(np.sum(np.asarray(out["success"])))

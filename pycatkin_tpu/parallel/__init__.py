@@ -1,3 +1,4 @@
-from .batch import (batch_steady_state, batch_transient, make_mesh,
-                    shard_conditions, stack_conditions, sweep_steady_state)
+from .batch import (batch_steady_state, batch_transient,
+                    continuation_sweep, make_mesh, shard_conditions,
+                    stack_conditions, sweep_steady_state)
 from .dispatch import dispatch_sweep, load_conditions, save_conditions

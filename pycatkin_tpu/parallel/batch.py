@@ -387,6 +387,16 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     fast = opts._replace(max_steps=min(opts.max_steps, 100),
                          max_attempts=1)
     res = batch_steady_state(spec, conds, x0=x0, opts=fast, mesh=mesh)
+    return _finish_sweep(spec, conds, res, opts, tof_mask,
+                         check_stability, pos_jac_tol)
+
+
+def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
+                  opts: SolverOptions, tof_mask, check_stability: bool,
+                  pos_jac_tol: float):
+    """Shared sweep tail: rescue ladder, stability verdict/demote loop,
+    TOF/activity -- everything downstream of the first solving pass
+    (used by both sweep_steady_state and continuation_sweep)."""
     # One scalar round trip decides both rescue phases (each
     # materialization call costs ~0.1-1 s on the tunneled backend).
     if int(np.asarray(jnp.sum(~jnp.asarray(res.success)))) > 0:
@@ -432,6 +442,65 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
         # visible signal. Reduced on device; one scalar crosses.
         _warn_negative_tof(np.asarray(n_neg))
     return out
+
+
+def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
+                       tof_mask=None,
+                       opts: SolverOptions = SolverOptions(),
+                       stage_opts: Optional[SolverOptions] = None,
+                       check_stability: bool = False,
+                       pos_jac_tol: float = 1e-2):
+    """Warm-started sweep along a continuation axis.
+
+    ``order``: [n_stages, m] integer lane indices covering every lane
+    exactly once, ordered so physically adjacent conditions share a
+    stage boundary (e.g. a T x p x dE grid staged along T). Stage 0
+    solves cold; every later stage seeds from the PREVIOUS stage's
+    solutions -- the reference's own sweep pattern (presets.py
+    run_temperatures carries each point's solution into the next), which
+    slashes Newton iterations for large per-lane systems where every
+    iteration pays a full Jacobian + LU (bench config 5). All stages
+    share ONE compiled program (same [m]-lane shape), and the stage
+    chain pipelines on device (x0 flows stage-to-stage as device
+    arrays; no host sync until the shared finishing tail).
+
+    ``stage_opts``: solver pacing for the seeded stages (default: start
+    near Newton -- dt0=1, fast growth, single attempt; a seeded lane
+    that still fails lands in the ordinary rescue ladder). Returns the
+    same dict as :func:`sweep_steady_state`, in original lane order.
+    """
+    order = np.asarray(order)
+    n_stages, m = order.shape
+    n_lanes = len(jax.tree_util.tree_leaves(conds)[0])
+    # A malformed order would silently place solutions on the wrong
+    # lanes with success=True -- wrong physics, no error. Refuse.
+    if not np.array_equal(np.sort(order.ravel()), np.arange(n_lanes)):
+        raise ValueError(
+            "continuation_sweep: `order` must contain every lane index "
+            f"exactly once (got shape {order.shape} for {n_lanes} lanes)")
+    dyn = jnp.asarray(spec.dynamic_indices)
+    first = opts._replace(max_steps=min(opts.max_steps, 100),
+                          max_attempts=1)
+    cont = stage_opts or opts._replace(dt0=1.0, dt_grow_min=10.0,
+                                       max_steps=60, max_attempts=1)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages * m)
+
+    subs = [jax.tree_util.tree_map(lambda a: jnp.asarray(a)[order[s]],
+                                   conds)
+            for s in range(n_stages)]
+    stage_res = [None] * n_stages
+    stage_res[0] = _steady_program(spec, first)(subs[0], keys[:m], None)
+    prog = _steady_program(spec, cont)
+    for s in range(1, n_stages):
+        x0 = stage_res[s - 1].x[:, dyn]
+        stage_res[s] = prog(subs[s], keys[s * m:(s + 1) * m], x0)
+
+    # Reassemble into original lane order (pure device ops).
+    inv = np.argsort(order.ravel())
+    res = jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0)[inv], *stage_res)
+    return _finish_sweep(spec, conds, res, opts, tof_mask,
+                         check_stability, pos_jac_tol)
 
 
 def shard_conditions(conds: Conditions, mesh: Mesh):

@@ -105,9 +105,20 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
             env=env, cwd=os.getcwd())))
 
     failed = []
+    # ``timeout`` is a SHARED deadline for the whole sweep, not a
+    # per-worker budget (a sequential per-worker wait would bound the
+    # call at ~n_workers * timeout).
+    import time as _time
+    deadline = (_time.monotonic() + timeout) if timeout else None
     try:
         for i, out_path, p in procs:
-            rc = p.wait(timeout=timeout)
+            try:
+                remaining = (max(0.0, deadline - _time.monotonic())
+                             if deadline is not None else None)
+                rc = p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                failed.append(i)
+                continue
             if rc != 0 or not os.path.exists(out_path):
                 failed.append(i)
     finally:
@@ -119,8 +130,8 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                 p.wait()
     if failed:
         raise RuntimeError(
-            f"dispatch_sweep: worker block(s) {failed} failed; inputs "
-            f"and any partial results are in {work_dir}")
+            f"dispatch_sweep: worker block(s) {failed} failed or timed "
+            f"out; inputs and any partial results are in {work_dir}")
 
     merged: dict = {}
     for i, out_path, _ in procs:

@@ -117,3 +117,29 @@ def test_mesh_sharded_drc_matches_unsharded(volcano):
     xi_np = np.asarray(xi)
     assert np.all(np.isfinite(xi_np))
     assert np.any(np.abs(xi_np) > 1e-6)
+
+
+def test_continuation_sweep_matches_plain(volcano):
+    """Warm-started continuation staging (the reference presets.py
+    pattern: each sweep point seeds the next) reaches the same roots as
+    the cold batched sweep, in the original lane order."""
+    from pycatkin_tpu.parallel import continuation_sweep
+
+    grid = [(-1.0 - 0.15 * i, -1.0 + 0.05 * j)
+            for i in range(4) for j in range(3)]
+    conds = _volcano_conditions(volcano, grid)
+    mask = engine.tof_mask_for(volcano.spec, ["CO_ox"])
+    plain = sweep_steady_state(volcano.spec, conds, tof_mask=mask)
+    order = np.arange(12).reshape(4, 3)   # stage along the E_CO axis
+    cont = continuation_sweep(volcano.spec, conds, order, tof_mask=mask)
+    assert np.all(np.asarray(plain["success"]))
+    assert np.all(np.asarray(cont["success"]))
+    np.testing.assert_allclose(np.asarray(cont["y"]),
+                               np.asarray(plain["y"]),
+                               rtol=1e-6, atol=1e-9)
+    # Activity is log(TOF) of a near-cancelling flux difference, so
+    # solver-tolerance root differences amplify; agreement at the
+    # physically meaningful scale (~10 meV) is the honest contract.
+    np.testing.assert_allclose(np.asarray(cont["activity"]),
+                               np.asarray(plain["activity"]),
+                               rtol=0, atol=2e-2)
