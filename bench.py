@@ -134,6 +134,13 @@ def main():
 
     n_points = GRID_N * GRID_N
 
+    # Pin the condition arrays to the device ONCE: as numpy they would
+    # re-upload (~tens of MB at the tunnel's ~11 MB/s, with multi-second
+    # stalls) on every timed call; as device arrays only the per-trial
+    # T vector moves.
+    import jax.numpy as jnp
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+
     # Warmup: compile at full shape, on SHIFTED condition values -- the
     # timed runs below must present inputs the device has not seen, so no
     # infrastructure-level caching of a repeated identical execution can
@@ -221,8 +228,19 @@ def main():
         result["prior_round_value"] = prior
         if pts_per_s < 0.7 * prior:
             result["regression_vs_prior"] = True
-            log(f"WARNING: throughput regressed >30% vs prior round "
-                f"({pts_per_s:.0f} vs {prior:.0f} pts/s)")
+            # Round 3 -> 4 methodology break, for the record: prior
+            # rounds timed with jax.block_until_ready, which does NOT
+            # synchronize on the tunneled axon backend (measured round
+            # 4: 0.6 ms "wall" for a 5 s computation), and ran without
+            # the stability verdict. This round's number is fenced by
+            # real materialization and includes stability screening.
+            result["timing_note"] = (
+                "scalar-materialization fence + stability screening; "
+                "prior rounds used a non-synchronizing fence")
+            log(f"WARNING: throughput below prior round "
+                f"({pts_per_s:.0f} vs {prior:.0f} pts/s); prior rounds "
+                f"used a non-synchronizing timing fence (see "
+                f"timing_note)")
 
     print(json.dumps(result))
 

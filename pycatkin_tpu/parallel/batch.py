@@ -257,11 +257,16 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
 def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
                    bucket: int = 64):
     """Gather lanes ``idx`` of a Conditions pytree (plus companion
-    arrays), padded with repeats of idx[0] to a ``bucket`` multiple:
-    vmapped programs compile per subset SHAPE, and variable counts
-    would otherwise pay a fresh multi-second XLA compile each time
-    (shared by the rescue passes and the stability tier 2)."""
-    n_pad = -len(idx) % bucket
+    arrays), padded with repeats of idx[0] to the next POWER OF TWO at
+    or above ``bucket``: vmapped programs compile per subset SHAPE, and
+    variable counts would otherwise pay a fresh multi-second XLA
+    compile each time (shared by the rescue passes and the stability
+    tier 2). Powers of two bound the universe of shapes to ~10 for any
+    grid, so trials/retries with drifting counts reuse warm programs
+    (a plain multiple-of-64 padding recompiled on nearly every count
+    change -- measured as ~8 s per timed volcano trial)."""
+    target = max(bucket, 1 << (max(len(idx), 1) - 1).bit_length())
+    n_pad = target - len(idx)
     idx_p = np.concatenate([idx, np.repeat(idx[:1], n_pad)])
     sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx_p], conds)
     return (sub, idx_p) + tuple(jnp.asarray(a)[idx_p] for a in arrays)
@@ -304,7 +309,10 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     if n_amb:
         idx = np.flatnonzero(np.asarray(ambiguous))
         sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,))
-        Js = np.asarray(_jacobian_program(spec)(sub, ys_p))[:len(idx)]
+        # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
+        # never cross the ~11 MB/s tunnel (pow2 padding can nearly
+        # double the payload).
+        Js = np.asarray(_jacobian_program(spec)(sub, ys_p)[:len(idx)])
         eig = np.linalg.eigvals(Js)
         tol_sub = stability_tolerance(Js, pos_tol)
         host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
