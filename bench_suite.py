@@ -488,12 +488,17 @@ def config_5():
     spec = sim.spec
     n_dyn = len(spec.dynamic_indices)
     assert n_dyn > 48, f"LU path not exercised (n_dyn={n_dyn})"
-    # Aggressive PTC pacing for LARGE per-lane systems: at n_dyn=190
-    # every iteration pays a full Jacobian + LU, so dt-ramp iterations
-    # are the cost center (2.3x wall vs the defaults; measured matrix in
-    # docs/perf_config5.md). The conservative defaults stay global --
-    # they win on the small-network volcano/sweep configs.
-    opts = SolverOptions(dt0=1.0e-3, dt_grow_min=6.0)
+    # Large-system pacing (measured ladder in docs/perf_config5.md
+    # §3/§10): at n_dyn=190 every PTC body pays a full Jacobian + LU
+    # (~190 ms at this batch shape), so the winning economics are FEW
+    # bodies, each amortized by chord steps re-using its factorization
+    # (one residual + triangular solve each). dt0=100 starts
+    # essentially at Newton (rejection-and-shrink still globalizes);
+    # chords repair ramp overshoot before the next factorization.
+    # 49.8 -> 105.4 lanes/s vs the round-3 pacing, 128/128 converged,
+    # same roots (median |dy| ~1e-7). The conservative defaults stay
+    # global -- they win on the small-network volcano/sweep configs.
+    opts = SolverOptions(dt0=100.0, dt_grow_min=30.0, chord_steps=4)
 
     Ts = np.linspace(420.0, 700.0, 8)
     ps = np.logspace(4.0, 6.0, 4)

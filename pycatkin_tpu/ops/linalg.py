@@ -311,3 +311,43 @@ def solve(A: jnp.ndarray, b: jnp.ndarray):
         return gauss_solve(A, b)
     LU, perm = lu_factor(A)
     return lu_solve(LU, perm, b)
+
+
+def make_mixed_solve(A: jnp.ndarray):
+    """Factor A once in hardware float32, return an iteratively-refined
+    solve closure: row-equilibrate in f64 (keeps the cast in f32 range
+    and makes partial pivoting magnitude-meaningful), factor the f32
+    cast with the same sequential kernel, refine each solve with one
+    f64-residual correction pass. Returns solve_fn(b) -> x in A.dtype.
+
+    Round-4 TPU measurements (tools/exp_jac_perm.py, [128, 190, 190]):
+    2.4x faster than the emulated-f64 LU (51 vs 130 ms; XLA's native
+    f32 LuDecomposition custom call is unusable -- it kernel-faults
+    inside vmapped while_loops, docs/perf_config5.md §5), refined
+    directions good to ~1e-10 relative for cond(A) up to ~1e7 --
+    including severely ROW-scaled systems, which equilibration absorbs.
+    NOT wired into the steady-solver direction solve: stiff-kinetics
+    PTC matrices measure cond ~1e10-1e15 AFTER equilibration (the
+    stiffness is spectral, not a scaling artifact), refinement cannot
+    contract there, and the solve stalls (docs/perf_config5.md §9).
+    The honest prospective use is implicit-integrator stage matrices
+    I - h*gamma*J, whose conditioning is moderated by the accuracy-
+    limited step size h.
+    """
+    dtype = A.dtype
+    row_max = jnp.max(jnp.abs(A), axis=-1, keepdims=True)
+    r = jnp.where(row_max > 0, 1.0 / row_max, 1.0)
+    As = A * r                                   # equilibrated, f64
+    LU32, perm = lu_factor(As.astype(jnp.float32))
+
+    def solve_fn(b):
+        # b: [n] or [n, k] (the module's RHS convention); the row scale
+        # r is [n, 1], which broadcasts correctly over matrix RHS but
+        # must be squeezed for vector RHS.
+        bs = b * (r[..., 0] if b.ndim == r.ndim - 1 else r)
+        x = lu_solve(LU32, perm, bs.astype(jnp.float32)).astype(dtype)
+        res = bs - As @ x                        # f64 residual
+        dx = lu_solve(LU32, perm, res.astype(jnp.float32)).astype(dtype)
+        return x + dx
+
+    return solve_fn

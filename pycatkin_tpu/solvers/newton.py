@@ -74,6 +74,19 @@ class SolverOptions(NamedTuple):
     max_steps: int = 200         # PTC iterations per attempt
     max_attempts: int = 5
     floor: float = 1.0e-32       # reference min_tol
+    # Large-system iteration economics (round-4, docs/perf_config5.md):
+    # at n_dyn ~ 190 each PTC iteration pays a full Jacobian (~33 ms) +
+    # LU (~130 ms under f64 emulation). Chord steps amortize that cost:
+    # after each Newton/PTC step, up to this many extra steps re-use the
+    # SAME factorization (one residual + one triangular solve each -- no
+    # new Jacobian/LU), kept only on strict residual decrease. Default
+    # OFF; the big-network bench/sweep configs turn it on. (A hardware-
+    # f32 direction factorization was measured 2.4x faster but CANNOT
+    # serve stiff kinetics: equilibrated PTC matrices carry cond
+    # ~1e10-1e15, far beyond f32 refinement's ~1e7 ceiling -- the solver
+    # stalled. Recorded in docs/perf_config5.md; kernel kept as
+    # linalg.make_mixed_solve.)
+    chord_steps: int = 0
 
 
 def _normalize(x, groups_dyn, floor):
@@ -98,17 +111,29 @@ def _rnorm(F, gross, opts: SolverOptions):
     return jnp.max(jnp.abs(F) / (opts.rate_tol + opts.rate_tol_rel * gross))
 
 
-def _direction_solve(A, b):
-    """Newton/PTC direction solve (one site for future kernel swaps).
+def _direction_factor(A, opts: SolverOptions | None):
+    """Factor the Newton/PTC matrix once, return a solve closure (the
+    one site for direction-kernel dispatch; chord steps re-use it).
 
-    Stays on the full-precision arithmetic kernels everywhere. The
-    round-4 mixed-precision experiments are recorded in
+    Always the full-precision arithmetic kernels (small n: one
+    Gauss-Jordan inverse, large n: sequential LU). Faster direction
+    kernels were measured and REJECTED for this site, recorded in
     docs/perf_config5.md: XLA:TPU's native f32 LuDecomposition custom
-    call crashes the TPU worker when invoked inside a vmapped
-    while_loop, and an f32 statically-blocked factorization compiled
-    93 s, ran 5x slower than the emulated-f64 kernels, and lost the
-    refinement contraction on hard row-scaled matrices."""
-    return linalg.solve(A, b)
+    call kernel-faults inside vmapped while_loops, and the refined
+    mixed-precision factorization (linalg.make_mixed_solve, 2.4x
+    faster at [128, 190, 190]) stalls the solve outright -- stiff
+    kinetics PTC matrices measure cond ~1e10-1e15 AFTER row
+    equilibration, beyond f32 refinement's ~1e7 contraction ceiling,
+    at every pseudo-time scale (the 1e-14 dt clip floor keeps I/dt
+    from ever dominating a ||J|| ~ 1e16+ Jacobian)."""
+    if opts is not None and opts.chord_steps > 0:
+        return linalg.make_msolve(A)
+    return lambda b: linalg.solve(A, b)
+
+
+def _direction_solve(A, b, opts: SolverOptions | None = None):
+    """One-shot direction solve (kept for single-solve call sites)."""
+    return _direction_factor(A, opts)(b)
 
 
 def conservation_constraints(groups_dyn):
@@ -157,7 +182,8 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         x, F, dt, fnorm, k = state
         J = jac_fn(x)
         A = jnp.where(M[:, None] > 0, R, eye / dt - J)
-        dx = _direction_solve(A, F * (1.0 - M))
+        solve_fn = _direction_factor(A, opts)
+        dx = solve_fn(F * (1.0 - M))
         # Projected PTC: clamp nonnegative AND renormalize conservation
         # groups (reference min_tol flooring + _normalize_y semantics,
         # system.py:305-328). Negative coverages flip rate signs and
@@ -169,6 +195,28 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
                            opts.floor)
         F_new, gross_new = fscale_fn(x_new)
         fnorm_new = _rnorm(F_new, gross_new, opts)
+        # Chord steps: re-use the factorization against the fresh
+        # residual (frozen-Jacobian Newton). Each costs one residual
+        # evaluation + one triangular solve -- no Jacobian, no LU --
+        # and is kept only on strict residual decrease, so a stale
+        # direction can slow nothing down. The SER growth below then
+        # sees the full (Newton + chords) residual drop. The gross
+        # scale is FROZEN at the body's Newton point (gross_new): the
+        # yardstick moves smoothly with x, chord displacements are
+        # small, and not consuming gross_c lets XLA dead-code-eliminate
+        # the |S| matmul from every chord evaluation; the residual the
+        # attempt RETURNS is re-measured against a fresh scale below.
+        for _ in range(opts.chord_steps):
+            dxc = solve_fn(F_new * (1.0 - M))
+            x_c = _normalize(jnp.maximum(x_new + dxc, 0.0), groups_dyn,
+                             opts.floor)
+            F_c, _ = fscale_fn(x_c)
+            f_c = _rnorm(F_c, gross_new, opts)
+            take = (jnp.isfinite(f_c) & jnp.all(jnp.isfinite(x_c))
+                    & (f_c < fnorm_new))
+            x_new = jnp.where(take, x_c, x_new)
+            F_new = jnp.where(take, F_c, F_new)
+            fnorm_new = jnp.where(take, f_c, fnorm_new)
         finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
         # Accept steps that do not blow the residual up; a mild increase
         # is tolerated (transient phase of the pseudo-time march).
@@ -191,6 +239,13 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     f0 = _rnorm(F0, gross0, opts)
     x, F, dt, fnorm, k = jax.lax.while_loop(
         cond, body, (x0, F0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
+    if opts.chord_steps > 0:
+        # Chord accepts were judged against a frozen gross scale;
+        # re-measure the returned residual against the fresh one so the
+        # verdict downstream cannot inherit a stale yardstick. (One
+        # evaluation per ATTEMPT -- noise next to the loop's cost.)
+        Fx, grossx = fscale_fn(x)
+        fnorm = _rnorm(Fx, grossx, opts)
     return x, fnorm, k
 
 
@@ -272,6 +327,9 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         dmax = jnp.maximum(jnp.max(jnp.diag(JtJ)), 1e-300)
         A = jnp.where(M[:, None] > 0, R, JtJ + (lam * dmax) * eye)
         g = jnp.where(M > 0, 0.0, J.T @ (F / scale))
+        # LM stays full-precision: JtJ squares the condition number, so
+        # the f32 direction path is not offered here (LM is the rescue
+        # strategy -- robustness over speed).
         dx = _direction_solve(A, -g * (1.0 - M))
         x_new = _normalize(jnp.maximum(x + dx, 0.0), groups_dyn,
                            opts.floor)

@@ -424,3 +424,36 @@ def test_lexicographic_score_ordering():
     s1 = newton._score(good, 0.9, groups, opts)
     s2 = newton._score(good, 0.2, groups, opts)
     assert float(s2) > float(s1)
+
+
+def test_chord_steps_same_root():
+    """chord_steps re-uses each iteration's factorization for cheap
+    frozen-Jacobian extra steps (large-network iteration economics,
+    docs/perf_config5.md §9); the solve must land on the same root as
+    the plain path, for both the small-n (Gauss-Jordan inverse) and the
+    large-n (LU) direction kernels."""
+    import numpy as np
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.solvers.newton import SolverOptions
+
+    for n_sp, n_rx, seed in ((20, 40, 1), (60, 150, 3)):
+        sim = synthetic_system(n_species=n_sp, n_reactions=n_rx,
+                               seed=seed)
+        spec, cond = sim.spec, sim.conditions()
+        r0 = engine.steady_state(spec, cond)
+        r2 = engine.steady_state(
+            spec, cond, opts=SolverOptions(chord_steps=2))
+        assert bool(r0.success) and bool(r2.success)
+        # Both stop at the same residual tolerance; with the stiff
+        # Jacobian's conditioning (~1e10+) that pins the POSITION only
+        # to ~1e-4 -- the two paths' answers differ by solver precision,
+        # not by basin (a different root on these networks sits orders
+        # of magnitude away in multiple coordinates).
+        d = float(np.max(np.abs(np.asarray(r0.x) - np.asarray(r2.x))))
+        assert d < 5e-3, f"chord root drifted: {d:.2e} (n={n_sp})"
+        # chords should not lengthen the outer trajectory materially
+        # (not a hard invariant -- the chord path's dt trajectory
+        # diverges from the plain one at iteration 1, so allow slack).
+        assert int(r2.iterations) <= int(r0.iterations) + 2

@@ -1,0 +1,102 @@
+"""Experiment: economics of a fori-blocked LU at config-5 shapes.
+
+Measures, with chained data-dependent iterations + single-scalar fences
+(the honest methodology from bench_suite.config_1):
+  1. emulated-f64 batched matmul cost at panel shapes
+  2. current sequential lu_factor / lu_solve cost
+  3. (once implemented) the fori-blocked variant
+
+Run: python tools/exp_blocked_lu.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu.ops import linalg
+
+L, N, B = 128, 190, 32
+
+
+def chain_time(make_body, x0, n_hi=8, n_lo=1, reps=3, tag=""):
+    """Marginal seconds per body application, via two chain lengths.
+
+    make_body(x) -> x' must be data-dependent on x so chained calls
+    cannot overlap; the return is reduced to ONE scalar (one tunnel
+    round trip inside the timed window)."""
+    def chain(x, n):
+        def step(c, _):
+            return make_body(c), ()
+        y, _ = jax.lax.scan(step, x, None, length=n)
+        return jnp.sum(y)
+
+    hi = jax.jit(lambda x: chain(x, n_hi))
+    lo = jax.jit(lambda x: chain(x, n_lo))
+    float(np.asarray(hi(x0)))          # compile
+    float(np.asarray(lo(x0)))
+    rng = np.random.default_rng(0)
+    vals = []
+    for _ in range(reps):
+        x = x0 + 1e-9 * rng.uniform()   # fresh values each trial
+        t0 = time.perf_counter()
+        float(np.asarray(lo(x)))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(hi(x)))
+        t_hi = time.perf_counter() - t0
+        vals.append((t_hi - t_lo) / (n_hi - n_lo))
+    med = sorted(vals)[len(vals) // 2]
+    print(f"{tag:42s} {med*1e3:9.2f} ms  "
+          f"(min {min(vals)*1e3:.2f} max {max(vals)*1e3:.2f})", flush=True)
+    return med
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((L, N, N)) + 10.0 * np.eye(N))
+
+    # 1. f64 batched matmul: full [L,N,N]@[L,N,N]
+    chain_time(lambda X: (X @ A) * (1.0 / N), A, tag="f64 matmul [128,190,190]^2")
+
+    # panel-shaped matmul [L,N,B]@[L,B,N]
+    P0 = jnp.asarray(rng.standard_normal((L, N, B)))
+    def panel_mm(X):
+        P = X[:, :, :B]
+        return X - 1e-6 * (P @ P.transpose(0, 2, 1))
+    chain_time(panel_mm, A, tag="f64 A -= panel[190,32]@[32,190]")
+
+    # f32 same matmul for comparison
+    A32 = A.astype(jnp.float32)
+    chain_time(lambda X: (X @ A32) * (1.0 / N), A32,
+               tag="f32 matmul [128,190,190]^2")
+
+    # 2. current sequential LU factor (data-dependent chaining: feed a
+    # tiny function of LU back into A's diagonal)
+    def lu_body(X):
+        LU, perm = jax.vmap(linalg.lu_factor)(X)
+        return A + 1e-12 * jnp.sum(LU) + 0.0 * X
+    chain_time(lu_body, A, n_hi=4, tag="sequential lu_factor [128,190,190]")
+
+    # full solve
+    b = jnp.asarray(rng.standard_normal((L, N)))
+    def solve_body(X):
+        x = jax.vmap(linalg.solve)(X, b)
+        return A + 1e-12 * jnp.mean(x)[None, None] + 0.0 * X
+    chain_time(solve_body, A, n_hi=4, tag="sequential solve [128,190,190]")
+
+
+if __name__ == "__main__":
+    main()

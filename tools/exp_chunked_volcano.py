@@ -1,0 +1,96 @@
+"""Experiment: chunked first-pass for the 256x256 volcano program.
+
+Hypothesis (docs/perf_config5.md §6): XLA compile time has a
+lane-dependent component (64 lanes: 23 s, 65536: 52 s), so jitting the
+fast pass at chunk shape [8192] and host-looping 8 dispatches should
+cut cold compile ~2x. Throughput may even improve: each chunk's
+while_loop runs to its OWN max-iteration lane instead of the global
+worst lane.
+
+Run: python tools/exp_chunked_volcano.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.models import coox
+from pycatkin_tpu.parallel import batch as pb
+
+GRID_N = 256
+
+
+def run_variant(spec, conds, mask, fence, chunk):
+    n = GRID_N * GRID_N
+    tag = f"chunk={chunk or 'full'}"
+    t0 = time.perf_counter()
+    out = sweep(spec, conds._replace(T=conds.T + 0.25), mask, chunk)
+    np.asarray(fence(out["y"], out["activity"], out["success"]))
+    compile_s = time.perf_counter() - t0
+    walls = []
+    for i in range(3):
+        c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1))
+        t0 = time.perf_counter()
+        out = sweep(spec, c_i, mask, chunk)
+        float(np.asarray(fence(out["y"], out["activity"],
+                               out["success"])))
+        walls.append(time.perf_counter() - t0)
+    w = sorted(walls)[1]
+    n_ok = int(np.sum(np.asarray(out["success"])))
+    print(f"{tag:12s} compile+first {compile_s:6.1f} s; "
+          f"walls {['%.2f' % x for x in walls]} -> {n/w:8.0f} pts/s; "
+          f"ok {n_ok}/{n}", flush=True)
+
+
+def sweep(spec, conds, mask, chunk):
+    from pycatkin_tpu.solvers.newton import SolverOptions
+    opts = SolverOptions()
+    if not chunk:
+        return pb.sweep_steady_state(spec, conds, tof_mask=mask)
+    # chunked fast pass, shared finish tail
+    fast = opts._replace(max_steps=100, max_attempts=1)
+    n = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    prog = pb._steady_program(spec, fast)
+    outs = []
+    for i0 in range(0, n, chunk):
+        sub = jax.tree_util.tree_map(lambda a: a[i0:i0 + chunk], conds)
+        outs.append(prog(sub, keys[i0:i0 + chunk], None))
+    res = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *outs)
+    return pb._finish_sweep(spec, conds, res, opts, mask, False, 1e-2)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    sim = pk.read_from_input_file(
+        "/root/reference/examples/COOxVolcano/input.json")
+    be = np.linspace(-2.5, 0.5, GRID_N)
+    conds, shape = coox.volcano_grid_conditions(sim, be)
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+    mask = engine.tof_mask_for(sim.spec, ["CO_ox"])
+    from bench import result_fence
+    fence = result_fence()
+
+    which = sys.argv[1:] or ["full", "8192", "16384"]
+    for w in which:
+        run_variant(sim.spec, conds, mask, fence,
+                    None if w == "full" else int(w))
+
+
+if __name__ == "__main__":
+    main()

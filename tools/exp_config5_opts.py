@@ -1,0 +1,85 @@
+"""Sweep SolverOptions variants on the config-5 workload (TPU).
+
+For each variant: lanes/s (scalar-fenced, fresh inputs), iteration
+stats, convergence. Run: python tools/exp_config5_opts.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.solvers.newton import SolverOptions
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+
+    sim = synthetic_system(n_species=200, n_reactions=500, seed=0)
+    spec = sim.spec
+    Ts = np.linspace(420.0, 700.0, 8)
+    ps = np.logspace(4.0, 6.0, 4)
+    dEs = np.linspace(-0.15, 0.15, 4)
+    TT, PP, EE = np.meshgrid(Ts, ps, dEs, indexing="ij")
+    n = TT.size
+    base = sim.conditions()
+    eps = np.zeros((n, len(spec.snames)))
+    eps[:, spec.is_adsorbate.astype(bool)] = EE.ravel()[:, None]
+    conds = broadcast_conditions(base, n)._replace(
+        T=TT.ravel(), p=PP.ravel(), eps=eps)
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+
+    from bench import result_fence
+    fence = result_fence()
+
+    variants = [
+        ("c4 g30 dt0=10",   SolverOptions(dt0=10.0, dt_grow_min=30.0,
+                                           chord_steps=4)),
+        ("c3 g30 dt0=1",    SolverOptions(dt0=1.0, dt_grow_min=30.0,
+                                           chord_steps=3)),
+        ("c5 g30 dt0=1",    SolverOptions(dt0=1.0, dt_grow_min=30.0,
+                                           chord_steps=5)),
+        ("c4 g30 dt0=100",  SolverOptions(dt0=100.0, dt_grow_min=30.0,
+                                           chord_steps=4)),
+    ]
+    for tag, opts in variants:
+        t0 = time.perf_counter()
+        warm = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
+                                  tof_mask=mask, opts=opts)
+        np.asarray(fence(warm["y"], warm["activity"], warm["success"]))
+        compile_s = time.perf_counter() - t0
+        walls, out = [], None
+        for i in range(3):
+            c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1))
+            t0 = time.perf_counter()
+            out = sweep_steady_state(spec, c_i, tof_mask=mask, opts=opts)
+            float(np.asarray(fence(out["y"], out["activity"],
+                                   out["success"])))
+            walls.append(time.perf_counter() - t0)
+        w = sorted(walls)[1]
+        iters = np.asarray(out["iterations"])
+        n_ok = int(np.sum(np.asarray(out["success"])))
+        print(f"{tag:18s} {n/w:6.1f} lanes/s "
+              f"(walls {['%.2f' % x for x in walls]}) "
+              f"iters mean {iters.mean():.1f} max {iters.max()} "
+              f"ok {n_ok}/{n} compile {compile_s:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Measure config-5 hot-path component variants on the real TPU:
+  - lu_factor at unroll 32/64/96, f64 vs f32
+  - lu_solve unroll variants
+  - jacfwd f64 vs f32
+  - row-gather vs one-hot permutation application
+
+Run: python tools/exp_jac_perm.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+from tools.exp_blocked_lu import chain_time
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.ops import linalg
+from pycatkin_tpu.parallel.batch import broadcast_conditions
+
+L, N = 128, 190
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((L, N, N)) + 10.0 * np.eye(N))
+    b = jnp.asarray(rng.standard_normal((L, N)))
+
+    for unroll in (32, 64, 96):
+        def body(X, u=unroll):
+            LU, perm = jax.vmap(lambda M: linalg.lu_factor(M, unroll=u))(X)
+            return A + 1e-12 * jnp.sum(LU) + 0.0 * X
+        chain_time(body, A, n_hi=4, tag=f"f64 lu_factor unroll={unroll}")
+
+    A32 = A.astype(jnp.float32)
+    for unroll in (32, 64):
+        def body32(X, u=unroll):
+            LU, perm = jax.vmap(lambda M: linalg.lu_factor(M, unroll=u))(X)
+            return A32 + 1e-6 * jnp.sum(LU) + 0.0 * X
+        chain_time(body32, A32, n_hi=4, tag=f"f32 lu_factor unroll={unroll}")
+
+    # full solve f32
+    def solve32(X):
+        x = jax.vmap(linalg.solve)(X, b.astype(jnp.float32))
+        return A32 + 1e-6 * jnp.mean(x) + 0.0 * X
+    chain_time(solve32, A32, n_hi=4, tag="f32 solve (factor+tri)")
+
+    # jacfwd f64 vs f32
+    sim = synthetic_system(n_species=200, n_reactions=500, seed=0)
+    spec = sim.spec
+    dyn = np.asarray(spec.dynamic_indices)
+    Ts = np.linspace(420.0, 700.0, L)
+    conds = broadcast_conditions(sim.conditions(), L)._replace(T=Ts)
+    x0 = jnp.asarray(np.asarray(conds.y0)[:, dyn])
+
+    def jac_one(cond, x):
+        kf, kr, _ = engine.rate_constants(spec, cond)
+        fscale, _, _ = engine._dynamic_fscale(spec, cond, kf, kr)
+        return jax.jacfwd(lambda z: fscale(z)[0])(x)
+
+    jf = jax.vmap(jac_one, in_axes=(0, 0))
+
+    def body_jf(x):
+        J = jf(conds, x)
+        return x + 1e-15 * jnp.sum(J)
+    chain_time(body_jf, x0, n_hi=8, tag="f64 jacfwd [128,190,190]")
+
+    def jac_one32(cond, x):
+        kf, kr, _ = engine.rate_constants(spec, cond)
+        fscale, _, _ = engine._dynamic_fscale(spec, cond, kf, kr)
+        kf32 = None  # tangents in f32: push f32 basis through f64 fn
+        Jrow = jax.jacfwd(lambda z: fscale(z)[0])(x)
+        return Jrow
+
+    # f32 jacobian: cast primal path to f32 wholesale is invasive;
+    # instead measure jacfwd of the f64 fn then cast (upper bound is the
+    # f64 number). Skip true-f32 until the solver variant exists.
+
+    # permutation application
+    pv = jnp.asarray(np.stack([rng.permutation(N) for _ in range(L)]))
+
+    def gather_body(X):
+        Y = jnp.take_along_axis(X, pv[:, :, None], axis=1)
+        return Y + 1e-12
+    chain_time(gather_body, A, n_hi=8, tag="f64 row gather A[pvec]")
+
+    def onehot_body(X):
+        P = (pv[:, :, None] == jnp.arange(N)[None, None, :]).astype(X.dtype)
+        return P @ X + 1e-12
+    chain_time(onehot_body, A, n_hi=8, tag="f64 one-hot P@A")
+
+
+if __name__ == "__main__":
+    main()
