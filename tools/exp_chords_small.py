@@ -1,0 +1,144 @@
+"""Do chord steps pay on SMALL systems? (config 1/3/4 shapes)
+
+Measures, with the honest chained/scalar fences:
+  - CH4 single-solve marginal device latency (config-1 method)
+  - DMTM 81-T sweep wall (config-3 method)
+  - COOx volcano 64x64 subgrid wall (config-4 method, smaller grid to
+    keep the experiment short)
+for SolverOptions() vs chord1 vs chord2 at default pacing.
+
+Run: python tools/exp_chords_small.py [ch4|dmtm|volcano]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+import pycatkin_tpu as pk
+from pycatkin_tpu import engine
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.solvers.newton import SolverOptions
+
+REF = "/root/reference"
+VARIANTS = [("default", SolverOptions()),
+            ("chord1", SolverOptions(chord_steps=1)),
+            ("chord2", SolverOptions(chord_steps=2))]
+
+
+def ch4():
+    sim = pk.read_from_input_file(os.path.join(REF, "test",
+                                               "CH4_input.json"))
+    spec, cond = sim.spec, sim.conditions()
+    print(f"CH4 n_dyn={len(spec.dynamic_indices)}", flush=True)
+    for tag, opts in VARIANTS:
+        def chain(c, n):
+            def body(carry, _):
+                T, _x = carry
+                res = engine.steady_state(spec, c._replace(T=T),
+                                          opts=opts)
+                return (T + res.x[0] * 1e-12 + 1e-9, res.x), res.success
+            (_, x_last), succ = jax.lax.scan(
+                body, (c.T, jnp.zeros(len(spec.snames))), None, length=n)
+            return jnp.sum(x_last) + jnp.sum(succ), succ
+        c1 = jax.jit(lambda c: chain(c, 1))
+        c25 = jax.jit(lambda c: chain(c, 25))
+        np.asarray(c1(cond._replace(T=cond.T + 0.3))[0])
+        np.asarray(c25(cond._replace(T=cond.T + 0.4))[0])
+        rng = np.random.default_rng(4)
+        vals, ok = [], True
+        for _ in range(3):
+            cT = cond._replace(T=cond.T + rng.uniform(0, .01))
+            t0 = time.perf_counter()
+            f, s1 = c1(cT)
+            float(np.asarray(f))
+            w1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            f, s25 = c25(cT)
+            float(np.asarray(f))
+            w25 = time.perf_counter() - t0
+            vals.append((w25 - w1) / 24.0)
+            ok = ok and bool(np.all(np.asarray(s25)))
+        res = engine.steady_state(spec, cond._replace(T=cond.T + 1e-9),
+                                  opts=opts)
+        print(f"CH4 {tag:8s} marginal {sorted(vals)[1]*1e3:7.2f} ms "
+              f"(min {min(vals)*1e3:.2f}) all_ok={ok} "
+              f"iters={int(res.iterations)}", flush=True)
+
+
+def dmtm():
+    sim = pk.read_from_input_file(os.path.join(REF, "examples", "DMTM",
+                                               "input.json"))
+    spec = sim.spec
+    n_T = 81
+    Ts = np.linspace(400.0, 800.0, n_T)
+    conds = broadcast_conditions(sim.conditions(), n_T)._replace(T=Ts)
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+    mask = engine.tof_mask_for(spec, ["r5", "r9"])
+    from bench import result_fence
+    fence = result_fence()
+    for tag, opts in VARIANTS:
+        warm = sweep_steady_state(spec, conds._replace(T=conds.T + .25),
+                                  tof_mask=mask, opts=opts)
+        np.asarray(fence(warm["y"], warm["activity"], warm["success"]))
+        walls, out = [], None
+        for i in range(3):
+            c_i = conds._replace(T=conds.T + 1e-7 * (i + 1))
+            t0 = time.perf_counter()
+            out = sweep_steady_state(spec, c_i, tof_mask=mask, opts=opts)
+            float(np.asarray(fence(out["y"], out["activity"],
+                                   out["success"])))
+            walls.append(time.perf_counter() - t0)
+        n_ok = int(np.sum(np.asarray(out["success"])))
+        print(f"DMTM {tag:8s} {n_T/sorted(walls)[1]:7.1f} T/s "
+              f"(walls {['%.3f' % w for w in walls]}) ok {n_ok}/{n_T}",
+              flush=True)
+
+
+def volcano():
+    from pycatkin_tpu.models import coox
+    sim = pk.read_from_input_file(
+        os.path.join(REF, "examples", "COOxVolcano", "input.json"))
+    be = np.linspace(-2.5, 0.5, 64)
+    conds, shape = coox.volcano_grid_conditions(sim, be)
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+    mask = engine.tof_mask_for(sim.spec, ["CO_ox"])
+    n = 64 * 64
+    from bench import result_fence
+    fence = result_fence()
+    for tag, opts in VARIANTS:
+        warm = sweep_steady_state(sim.spec,
+                                  conds._replace(T=conds.T + .25),
+                                  tof_mask=mask, opts=opts,
+                                  check_stability=True)
+        np.asarray(fence(warm["y"], warm["activity"], warm["success"]))
+        walls, out = [], None
+        for i in range(3):
+            c_i = conds._replace(T=conds.T + 1e-7 * (i + 1))
+            t0 = time.perf_counter()
+            out = sweep_steady_state(sim.spec, c_i, tof_mask=mask,
+                                     opts=opts, check_stability=True)
+            float(np.asarray(fence(out["y"], out["activity"],
+                                   out["success"])))
+            walls.append(time.perf_counter() - t0)
+        n_ok = int(np.sum(np.asarray(out["success"])))
+        print(f"volcano64 {tag:8s} {n/sorted(walls)[1]:8.0f} pts/s "
+              f"(walls {['%.3f' % w for w in walls]}) ok {n_ok}/{n}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["ch4", "dmtm", "volcano"]
+    for w in which:
+        {"ch4": ch4, "dmtm": dmtm, "volcano": volcano}[w]()
