@@ -31,21 +31,11 @@ def log(msg):
 
 
 def result_fence():
-    """One-scalar timing fence over a sweep result (shared by bench.py
-    and bench_suite.py so its guarantees cannot drift apart): the
-    returned jitted function reduces y + finite activities + success
-    flags to ONE scalar whose value depends on every output, so a
-    single materialization (one tunnel round trip) forces the whole
-    program chain to execute with nothing hidden."""
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def fence(y, activity, success):
-        act = jnp.where(jnp.isfinite(activity), activity, 0.0)
-        return jnp.sum(y) + jnp.sum(act) + jnp.sum(success)
-
-    return fence
+    """Sweep-result timing fence; canonical implementation lives in
+    :mod:`pycatkin_tpu.utils.profiling` (shared with ``run_timed`` and
+    bench_suite.py so the fence guarantees cannot drift apart)."""
+    from pycatkin_tpu.utils.profiling import result_fence as _rf
+    return _rf()
 
 
 def scipy_baseline_seconds_per_point(sim, sample_points):
@@ -141,16 +131,42 @@ def main():
     import jax.numpy as jnp
     conds = jax.tree_util.tree_map(jnp.asarray, conds)
 
-    # Warmup: compile at full shape, on SHIFTED condition values -- the
-    # timed runs below must present inputs the device has not seen, so no
-    # infrastructure-level caching of a repeated identical execution can
-    # fake the result.
+    # Pre-warm EVERY program shape the sweep can touch (fast pass,
+    # PTC/LM rescue seeded+unseeded at the pow2 buckets, stability
+    # screen + tier-2 Jacobian, TOF/activity): the rescue/tier-2
+    # programs otherwise compile lazily the first time lanes fail --
+    # tens of seconds of remote compile, plus its transport-flake risk,
+    # INSIDE a timed trial (the round-4 bench died exactly there). On a
+    # warm persistent cache this is a disk load; cold it is the full
+    # compile bill, paid here and nowhere else.
+    from pycatkin_tpu.parallel.batch import prewarm_sweep_programs
+    from pycatkin_tpu.utils.retry import call_with_backend_retry
     t0 = time.perf_counter()
-    out = sweep_steady_state(spec, conds._replace(T=conds.T + 0.25),
-                             tof_mask=mask, check_stability=True)
+    n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
+                                    buckets=(64, 128, 256),
+                                    aot_buckets=(512, 1024),
+                                    check_stability=True, verbose=True)
+    prewarm_s = time.perf_counter() - t0
+    log(f"prewarm ({n_prog} programs, incl. any compiles): "
+        f"{prewarm_s:.2f} s")
+
+    # Warmup sweep on SHIFTED condition values -- the timed runs below
+    # must present inputs the device has not seen, so no
+    # infrastructure-level caching of a repeated identical execution can
+    # fake the result. NOTE on metrics: ALL compile cost (cold or
+    # cache-load) is absorbed by the prewarm above and reported as
+    # `prewarm_s`; this sweep's wall (`compile_s`, kept under its
+    # historical key) is therefore pure warm execution of the first
+    # full sweep -- it is NOT comparable to BENCH_r04's compile_s,
+    # which timed first-run-including-compile before prewarming
+    # existed.
+    t0 = time.perf_counter()
+    out = call_with_backend_retry(
+        sweep_steady_state, spec, conds._replace(T=conds.T + 0.25),
+        tof_mask=mask, check_stability=True, label="warmup sweep")
     np.asarray(out["y"])
     compile_and_run = time.perf_counter() - t0
-    log(f"first run (incl. compile): {compile_and_run:.2f} s")
+    log(f"warmup sweep: {compile_and_run:.2f} s")
     warm_out = out
 
     # Median of 3 trials, each on a uniquely shifted temperature grid
@@ -177,15 +193,38 @@ def main():
     np.asarray(checksum(warm_out["y"], warm_out["activity"],
                         warm_out["success"]))
 
+    def timed_trial(i, attempt):
+        # Fresh T shift per (trial, retry attempt): a retried trial must
+        # also present inputs the device has not seen, or an
+        # infrastructure-level cache of the failed-then-retried identical
+        # execution could serve it and fake the wall time.
+        c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1)
+                             + 1.0e-8 * attempt)
+        t0 = time.perf_counter()
+        o = sweep_steady_state(spec, c_i, tof_mask=mask,
+                               check_stability=True)
+        float(np.asarray(checksum(o["y"], o["activity"], o["success"])))
+        return time.perf_counter() - t0, o
+
     walls, last = [], None
     for i in range(3):
-        c_i = conds._replace(T=conds.T + 1.0e-7 * (i + 1))
-        t0 = time.perf_counter()
-        out = sweep_steady_state(spec, c_i, tof_mask=mask,
-                                 check_stability=True)
-        float(np.asarray(checksum(out["y"], out["activity"],
-                                  out["success"])))
-        walls.append(time.perf_counter() - t0)
+        # Trial-level retry: a transient backend flake re-runs the
+        # whole (pure) trial rather than killing the round's record.
+        # (The library's own inner retries around each program dispatch
+        # absorb most flakes first -- their backoff then lands IN the
+        # trial wall, which is the conservative direction: a flaky
+        # trial reads slower, never faster, and the retry is logged on
+        # stderr. This outer retry is the backstop for flakes the inner
+        # ones exhaust.)
+        attempt = {"n": -1}
+
+        def trial_once():
+            attempt["n"] += 1
+            return timed_trial(i, attempt["n"])
+
+        w, out = call_with_backend_retry(trial_once,
+                                         label=f"timed trial {i}")
+        walls.append(w)
         last = out
     wall = sorted(walls)[1]
     pts_per_s = n_points / wall
@@ -215,9 +254,15 @@ def main():
         # null when no baseline could be measured (no fabricated ratio).
         "vs_baseline": (round(vs_baseline, 2) if vs_baseline is not None
                         else None),
-        # compile+first-run wall time; ~solve-time on a warm persistent
-        # cache, ~2 min on a cold one (the VERDICT round-1 finding).
+        # First full sweep after prewarm: pure warm execution (all
+        # compile/cache-load cost lives in prewarm_s). NOT comparable
+        # to r4's compile_s, which timed first-run-incl-compile.
         "compile_s": round(compile_and_run, 2),
+        # Crash-proofing surface: pre-compiling/loading all 23 rescue/
+        # screen/tier-2 program shapes so no XLA compile can land
+        # inside a timed trial or production solve (see prewarm
+        # breakdown on stderr; floor analysis in docs/perf_mfu.md).
+        "prewarm_s": round(prewarm_s, 2),
     }
 
     # Regression tripwire vs the checked-in prior round (VERDICT r3

@@ -28,6 +28,7 @@ from .. import engine
 from ..frontend.spec import Conditions, ModelSpec
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
+from ..utils.retry import call_with_backend_retry
 
 
 # ---------------------------------------------------------------------
@@ -155,8 +156,23 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
         jax.random.PRNGKey(0),
         jax.tree_util.tree_leaves(conds)[0].shape[0])
 
+    # Retry covers BOTH failure windows: the dispatch (this is the
+    # LARGEST lazy compile of the sweep surface, so a dropped
+    # remote-compile connection here costs the most to lose) and the
+    # execution, which on the async backend only surfaces at a
+    # materialization -- hence the one-scalar sync inside the retried
+    # unit (~0.1 s round trip; downstream consumers materialize a
+    # scalar off this result immediately anyway).
     if mesh is None:
-        return _steady_program(spec, opts)(conds, keys, x0)
+        prog = _steady_program(spec, opts)
+
+        def run_solve():
+            out = prog(conds, keys, x0)
+            np.asarray(jnp.sum(out.residual))
+            return out
+
+        return call_with_backend_retry(run_solve,
+                                       label="batched steady solve")
 
     n_dev = mesh.devices.size
     conds_p, n = _pad_lanes(conds, n_dev)
@@ -167,7 +183,15 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
     conds_p = jax.device_put(conds_p, sharding)
-    out = _steady_program(spec, opts, sharding)(conds_p, keys_p, x0_p)
+    prog_sh = _steady_program(spec, opts, sharding)
+
+    def run_solve_sharded():
+        out = prog_sh(conds_p, keys_p, x0_p)
+        np.asarray(jnp.sum(out.residual))
+        return out
+
+    out = call_with_backend_retry(run_solve_sharded,
+                                  label="batched steady solve (sharded)")
     return jax.tree_util.tree_map(lambda x: x[:n], out)
 
 
@@ -303,16 +327,30 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     n = ys.shape[0]
     ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
               else jnp.ones(n, dtype=bool))
-    certified, ambiguous, n_amb_dev = _stability_screen_program(
-        spec, pos_tol)(conds, ys, ok_dev)
-    n_amb = int(np.asarray(n_amb_dev))               # scalar round trip
+    def run_screen():
+        # Dispatch AND the scalar materialization inside one retried
+        # unit: on the async backend an execution-time transport flake
+        # surfaces at the materialization, so retrying only the
+        # dispatch would not re-run the program.
+        cert, amb, n_amb_dev = _stability_screen_program(
+            spec, pos_tol)(conds, ys, ok_dev)
+        return cert, amb, int(np.asarray(n_amb_dev))  # scalar round trip
+
+    certified, ambiguous, n_amb = call_with_backend_retry(
+        run_screen, label="stability screen")
     if n_amb:
         idx = np.flatnonzero(np.asarray(ambiguous))
         sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,))
+
         # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
         # never cross the ~11 MB/s tunnel (pow2 padding can nearly
         # double the payload).
-        Js = np.asarray(_jacobian_program(spec)(sub, ys_p)[:len(idx)])
+        def run_jac():
+            return np.asarray(
+                _jacobian_program(spec)(sub, ys_p)[:len(idx)])
+
+        Js = call_with_backend_retry(run_jac,
+                                     label="stability tier-2 jacobian")
         eig = np.linalg.eigvals(Js)
         tol_sub = stability_tolerance(Js, pos_tol)
         host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
@@ -348,8 +386,19 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     x0 = (jnp.asarray(res.x)[idx_p][:, jnp.asarray(spec.dynamic_indices)]
           if use_x0 else None)
     keys = jax.random.split(jax.random.PRNGKey(seed), len(idx_p))
-    out = _steady_program(spec, opts, strategy=strategy)(sub, keys, x0)
-    got = np.asarray(out.success)[:len(idx)]
+
+    # Retry on transient compile-service/transport flakes: the rescue
+    # program compiles lazily at the failed subset's bucket shape, and
+    # one dropped remote-compile connection otherwise kills the whole
+    # sweep (the round-4 driver bench died exactly here). The success
+    # materialization rides inside the retried unit so execution-time
+    # flakes re-dispatch too.
+    def run_rescue():
+        o = _steady_program(spec, opts, strategy=strategy)(sub, keys, x0)
+        return o, np.asarray(o.success)[:len(idx)]
+
+    out, got = call_with_backend_retry(run_rescue,
+                                       label=f"rescue[{strategy}]")
     if not got.any():
         return res
     x = np.array(res.x)
@@ -392,9 +441,8 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     # ~p99 lane), then host-side rescue of the failed subset with the
     # full retry ladder, then the LM strategy fallback. Stragglers no
     # longer drag every lane through the whole retry ladder.
-    fast = opts._replace(max_steps=min(opts.max_steps, 100),
-                         max_attempts=1)
-    res = batch_steady_state(spec, conds, x0=x0, opts=fast, mesh=mesh)
+    res = batch_steady_state(spec, conds, x0=x0, opts=_fast_pass_opts(opts),
+                             mesh=mesh)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
                          check_stability, pos_jac_tol)
 
@@ -440,15 +488,24 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
                                          jnp.asarray(stable))
     if tof_mask is not None:
-        tofs, act, n_neg = _tof_program(spec)(conds, res.x,
-                                              jnp.asarray(tof_mask))
+        mask_arr = jnp.asarray(tof_mask)
+        tprog = _tof_program(spec)
+
+        def run_tof():
+            # The n_neg materialization doubles as the execution sync
+            # inside the retried unit (see batch_steady_state).
+            t, a, nn = tprog(conds, res.x, mask_arr)
+            return t, a, int(np.asarray(nn))
+
+        tofs, act, n_neg = call_with_backend_retry(run_tof,
+                                                   label="tof/activity")
         out["tof"] = tofs
         out["activity"] = act
         # Deterministic host-side sign check (NOT an async device
         # callback, which the tunneled axon backend silently skips): a
         # reverse-running lane must never win a volcano argmax with no
         # visible signal. Reduced on device; one scalar crosses.
-        _warn_negative_tof(np.asarray(n_neg))
+        _warn_negative_tof(n_neg)
     return out
 
 
@@ -487,8 +544,7 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
             "continuation_sweep: `order` must contain every lane index "
             f"exactly once (got shape {order.shape} for {n_lanes} lanes)")
     dyn = jnp.asarray(spec.dynamic_indices)
-    first = opts._replace(max_steps=min(opts.max_steps, 100),
-                          max_attempts=1)
+    first = _fast_pass_opts(opts)
     cont = stage_opts or opts._replace(dt0=1.0, dt_grow_min=10.0,
                                        max_steps=60, max_attempts=1)
     keys = jax.random.split(jax.random.PRNGKey(0), n_stages * m)
@@ -496,12 +552,22 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     subs = [jax.tree_util.tree_map(lambda a: jnp.asarray(a)[order[s]],
                                    conds)
             for s in range(n_stages)]
+    # Stage dispatches ride the retry for compile-time flakes only: a
+    # per-stage materialization would serialize the host into the
+    # stage chain and destroy the on-device x0 pipelining this function
+    # exists for. Execution-time flakes surface at the finishing tail's
+    # scalar check; callers needing full execution-retry coverage can
+    # re-invoke (the sweep is pure).
     stage_res = [None] * n_stages
-    stage_res[0] = _steady_program(spec, first)(subs[0], keys[:m], None)
+    stage_res[0] = call_with_backend_retry(
+        _steady_program(spec, first), subs[0], keys[:m], None,
+        label="continuation stage 0")
     prog = _steady_program(spec, cont)
     for s in range(1, n_stages):
         x0 = stage_res[s - 1].x[:, dyn]
-        stage_res[s] = prog(subs[s], keys[s * m:(s + 1) * m], x0)
+        stage_res[s] = call_with_backend_retry(
+            prog, subs[s], keys[s * m:(s + 1) * m], x0,
+            label=f"continuation stage {s}")
 
     # Reassemble into original lane order (pure device ops).
     inv = np.argsort(order.ravel())
@@ -509,6 +575,152 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
         lambda *leaves: jnp.concatenate(leaves, axis=0)[inv], *stage_res)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
                          check_stability, pos_jac_tol)
+
+
+def _fast_pass_opts(opts: SolverOptions) -> SolverOptions:
+    """The capped single-attempt first-pass options, derived in ONE
+    place: :func:`sweep_steady_state`, :func:`continuation_sweep` and
+    :func:`prewarm_sweep_programs` must agree exactly -- the compiled-
+    program caches key on the options value, so a drifted copy would
+    prewarm a program the sweep never runs (voiding the no-in-band-
+    compile guarantee with zero visible signal)."""
+    return opts._replace(max_steps=min(opts.max_steps, 100),
+                         max_attempts=1)
+
+
+def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
+                           tof_mask=None,
+                           opts: SolverOptions = SolverOptions(),
+                           buckets=(64, 128, 256),
+                           aot_buckets=(),
+                           check_stability: bool = True,
+                           pos_jac_tol: float = 1e-2,
+                           verbose: bool = False):
+    """Compile (or load from the persistent cache) every program
+    :func:`sweep_steady_state` can touch at this lane count, up to
+    rescue/ambiguous subsets of ``max(buckets + aot_buckets)`` lanes.
+
+    The sweep's hot path compiles lazily: the rescue ladder, the
+    x0-free demote re-solve and the stability tier-2 Jacobian all
+    compile at the failed/ambiguous subset's pow2 bucket shape the
+    first time lanes actually fail -- which lands tens of seconds of
+    remote compile (plus its transport flake risk, the round-4 bench
+    crash) inside a timed trial or a production solve. One call here
+    front-loads: the full-shape fast pass, the screen, the TOF/activity
+    program, and per pow2 bucket the PTC/LM rescue (seeded and
+    unseeded) plus the subset Jacobian.
+
+    ``buckets`` are compiled AND executed once (the jit dispatch caches
+    are then fully hot -- a later in-band hit is pure execution);
+    ``aot_buckets`` are compiled ahead-of-time only
+    (``.lower().compile()``, no device execution) -- cheaper to warm,
+    and a later in-band hit pays only the trace + persistent-cache
+    executable load, never the full compile. Put the likely failure
+    scales in ``buckets`` and the insurance scales in ``aot_buckets``.
+    A sweep whose failed subset pads beyond the largest bucket still
+    compiles in-band. Returns the number of programs touched; each
+    call (including its own materialization) rides the transient-error
+    retry, so a flake can never escape to the caller's timed region.
+    """
+    import time as _time
+
+    def _log(msg):
+        if verbose:
+            import sys as _sys
+            print(f"prewarm: {msg}", file=_sys.stderr, flush=True)
+
+    def timed_retry(fn, label):
+        t0 = _time.perf_counter()
+        out = call_with_backend_retry(fn, label=label)
+        _log(f"{label}: {_time.perf_counter() - t0:.2f} s")
+        return out
+
+    leaves = jax.tree_util.tree_leaves(conds)
+    n = leaves[0].shape[0]
+    keys_full = jax.random.split(jax.random.PRNGKey(0), n)
+    fast_prog = _steady_program(spec, _fast_pass_opts(opts))
+
+    def run_fast():
+        r = fast_prog(conds, keys_full, None)
+        np.asarray(jnp.sum(r.residual))      # sync inside the retry
+        return r
+
+    res = timed_retry(run_fast, f"fast pass @{n}")
+    ys = res.x
+    n_prog = 1
+    if check_stability:
+        ok = jnp.ones(n, dtype=bool)
+
+        def run_screen():
+            out = _stability_screen_program(spec, pos_jac_tol)(conds, ys,
+                                                               ok)
+            np.asarray(out[2])
+            return out
+
+        timed_retry(run_screen, f"stability screen @{n}")
+        n_prog += 1
+    if tof_mask is not None:
+        mask_arr = jnp.asarray(tof_mask)
+
+        def run_tof():
+            out = _tof_program(spec)(conds, ys, mask_arr)
+            np.asarray(out[2])
+            return out
+
+        timed_retry(run_tof, f"tof/activity @{n}")
+        n_prog += 1
+    dyn = jnp.asarray(spec.dynamic_indices)
+    for b in buckets:
+        idx = np.arange(b) % n
+        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
+        keys = jax.random.split(jax.random.PRNGKey(1), b)
+        x0 = jnp.asarray(ys)[idx][:, dyn]
+
+        def run_prog(prog, *args):
+            r = prog(*args)
+            np.asarray(jnp.sum(r.residual))
+            return r
+
+        for strat in ("ptc", "lm"):
+            prog = _steady_program(spec, opts, strategy=strat)
+            timed_retry(lambda p=prog: run_prog(p, sub, keys, x0),
+                        f"rescue[{strat}] @{b}")
+            n_prog += 1
+        # The stability demote loop rescues with use_x0=False -> x0=None,
+        # a DIFFERENT traced program than the seeded variant.
+        prog = _steady_program(spec, opts, strategy="ptc")
+        timed_retry(lambda: run_prog(prog, sub, keys, None),
+                    f"rescue[ptc,unseeded] @{b}")
+        n_prog += 1
+        if check_stability:
+            jprog = _jacobian_program(spec)
+            ysub = jnp.asarray(ys)[idx]
+
+            def run_jac():
+                J = jprog(sub, ysub)
+                np.asarray(jnp.sum(jnp.where(jnp.isfinite(J), J, 0.0)))
+                return J
+
+            timed_retry(run_jac, f"tier-2 jac @{b}")
+            n_prog += 1
+    for b in aot_buckets:
+        idx = np.arange(b) % n
+        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
+        keys = jax.random.split(jax.random.PRNGKey(1), b)
+        x0 = jnp.asarray(ys)[idx][:, dyn]
+        for strat, seed_x0 in (("ptc", x0), ("lm", x0), ("ptc", None)):
+            prog = _steady_program(spec, opts, strategy=strat)
+            timed_retry(
+                lambda p=prog, s=seed_x0: p.lower(sub, keys, s).compile(),
+                f"aot rescue[{strat}{'' if seed_x0 is not None else ',unseeded'}] @{b}")
+            n_prog += 1
+        if check_stability:
+            jprog = _jacobian_program(spec)
+            ysub = jnp.asarray(ys)[idx]
+            timed_retry(lambda: jprog.lower(sub, ysub).compile(),
+                        f"aot tier-2 jac @{b}")
+            n_prog += 1
+    return n_prog
 
 
 def shard_conditions(conds: Conditions, mesh: Mesh):

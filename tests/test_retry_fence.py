@@ -1,0 +1,113 @@
+"""Timing-fence and transient-retry unit tests (round-5 hardening).
+
+The honest-timing machinery (utils/profiling.checksum_fence /
+result_fence / run_timed) and the transient-backend retry
+(utils/retry) are what make the benchmark records trustworthy and the
+driver bench crash-proof; pin their semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu.utils.profiling import (checksum_fence, materialize,
+                                          result_fence, run_timed)
+from pycatkin_tpu.utils.retry import (call_with_backend_retry,
+                                      is_transient_backend_error)
+
+
+def test_checksum_fence_depends_on_every_leaf():
+    fence = checksum_fence()
+    tree = {"a": jnp.arange(4.0), "b": jnp.array([True, False]),
+            "c": jnp.arange(3)}
+    base = materialize(fence(tree))
+    bumped = materialize(fence({**tree, "a": jnp.arange(4.0) + 1.0}))
+    assert base == pytest.approx(0 + 1 + 2 + 3 + 1 + 0 + 1 + 2)
+    assert bumped == pytest.approx(base + 4.0)
+
+
+def test_checksum_fence_finite_under_nan_and_inf():
+    """A NaN/Inf lane must not poison the fence scalar, but must still
+    influence it (else a program could hide work behind NaNs)."""
+    fence = checksum_fence()
+    clean = materialize(fence(jnp.array([1.0, 2.0, 3.0])))
+    dirty = materialize(fence(jnp.array([1.0, jnp.nan, jnp.inf])))
+    assert np.isfinite(dirty)
+    assert dirty != clean
+    assert dirty == pytest.approx(1.0 + 2.0)     # 1 + two nonfinite
+
+
+def test_result_fence_matches_manual_sum():
+    fence = result_fence()
+    y = jnp.arange(6.0).reshape(2, 3)
+    act = jnp.array([1.5, jnp.nan])
+    succ = jnp.array([True, True])
+    got = materialize(fence(y, act, succ))
+    assert got == pytest.approx(15.0 + 1.5 + 2.0)
+
+
+def test_run_timed_fences_and_returns_result():
+    def f(x):
+        return {"y": jnp.cumsum(x), "ok": jnp.array(True)}
+
+    result, seconds = run_timed(f, jnp.arange(100.0), repeats=2)
+    assert float(np.asarray(result["y"])[-1]) == pytest.approx(4950.0)
+    assert seconds >= 0.0
+
+
+def test_retry_recovers_from_transient_error():
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: http://127.0.0.1:1/remote_compile: read body: "
+                "response body closed before all bytes were read")
+        return x + 1
+
+    out = call_with_backend_retry(flaky, 41, attempts=3,
+                                  base_delay_s=0.01, label="test")
+    assert out == 42
+    assert calls["n"] == 2
+
+
+def test_retry_does_not_swallow_program_errors():
+    def broken():
+        raise ValueError("genuine bug")
+
+    with pytest.raises(ValueError, match="genuine bug"):
+        call_with_backend_retry(broken, attempts=3, base_delay_s=0.01)
+
+    def bad_program():
+        raise jax.errors.JaxRuntimeError(
+            "INVALID_ARGUMENT: shapes do not match")
+
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        call_with_backend_retry(bad_program, attempts=3,
+                                base_delay_s=0.01)
+
+
+def test_retry_gives_up_after_bounded_attempts():
+    calls = {"n": 0}
+
+    def always_flaky():
+        calls["n"] += 1
+        raise jax.errors.JaxRuntimeError("UNAVAILABLE: socket closed")
+
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        call_with_backend_retry(always_flaky, attempts=3,
+                                base_delay_s=0.01)
+    assert calls["n"] == 3
+
+
+def test_transient_classifier():
+    assert is_transient_backend_error(jax.errors.JaxRuntimeError(
+        "INTERNAL: remote_compile: read body"))
+    assert is_transient_backend_error(jax.errors.JaxRuntimeError(
+        "UNAVAILABLE: failed to connect to all addresses"))
+    assert not is_transient_backend_error(jax.errors.JaxRuntimeError(
+        "INVALID_ARGUMENT: dot_general shape mismatch"))
+    assert not is_transient_backend_error(ValueError("remote_compile"))

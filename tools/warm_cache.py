@@ -10,10 +10,13 @@ run it after install, after a JAX upgrade, or in an image build:
 
     python tools/warm_cache.py [grid_n]
 
-Programs warmed: the capped first-pass sweep program at the full
-[grid_n^2] lane shape, its rescue programs (full-ladder PTC + LM at
-the 64-lane bucket), the stability screen, the subset Jacobian
-program, and the TOF/activity program -- the complete
+Programs warmed (via parallel.batch.prewarm_sweep_programs, the same
+routine bench.py runs before its timed region, with bench's exact
+bucket configuration): the fast-pass sweep program at the full
+[grid_n^2] lane shape, the PTC/LM rescue programs (seeded and
+unseeded) at the 64/128/256-lane pow2 buckets (executed) plus the
+512/1024 insurance buckets (AOT-compiled only), the stability screen +
+tier-2 subset Jacobian, and the TOF/activity program -- the complete
 sweep_steady_state surface for the flagship workload.
 """
 
@@ -33,13 +36,10 @@ import numpy as np  # noqa: E402
 def main():
     import time
 
-    import jax
-    import jax.numpy as jnp
-
     import pycatkin_tpu as pk
     from pycatkin_tpu import engine
     from pycatkin_tpu.models import coox
-    from pycatkin_tpu.parallel import batch
+    from pycatkin_tpu.parallel.batch import prewarm_sweep_programs
 
     grid_n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     ref = os.environ.get(
@@ -52,37 +52,16 @@ def main():
     be = np.linspace(-2.5, 0.5, grid_n)
     conds, _ = coox.volcano_grid_conditions(sim, be)
     mask = engine.tof_mask_for(spec, ["CO_ox"])
-    n = grid_n * grid_n
 
-    from pycatkin_tpu.solvers.newton import SolverOptions
-    opts = SolverOptions()
     t0 = time.perf_counter()
-    # Main sweep surface (first pass + screen + tof/activity).
-    out = batch.sweep_steady_state(spec, conds, tof_mask=mask,
-                                   check_stability=True)
-    np.asarray(out["y"])
-    print(f"sweep programs: {time.perf_counter() - t0:.1f} s")
-
-    # Rescue programs at the 64-lane bucket (compiled lazily only when
-    # lanes fail; warm them explicitly so a hard grid's first failure
-    # doesn't pay the compile).
-    t0 = time.perf_counter()
-    sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[:64], conds)
-    keys = jax.random.split(jax.random.PRNGKey(0), 64)
-    x0 = jnp.asarray(out["y"])[:64][:, jnp.asarray(spec.dynamic_indices)]
-    for strat in ("ptc", "lm"):
-        r = batch._steady_program(spec, opts, strategy=strat)(sub, keys,
-                                                              x0)
-        np.asarray(r.residual)
-    # The stability demote loop rescues with use_x0=False -> x0=None,
-    # which traces a DIFFERENT program than the x0-array variant above.
-    r = batch._steady_program(spec, opts, strategy="ptc")(sub, keys, None)
-    np.asarray(r.residual)
-    # Subset Jacobian program (stability tier 2) at the same bucket.
-    np.asarray(batch._jacobian_program(spec)(sub,
-                                             jnp.asarray(out["y"])[:64]))
-    print(f"rescue + tier-2 programs: {time.perf_counter() - t0:.1f} s")
-    print(f"warm: a fresh process now loads all {n}-lane volcano "
+    # EXACTLY bench.py's prewarm configuration: an image warmed here
+    # must leave bench's prewarm nothing to compile.
+    n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
+                                    buckets=(64, 128, 256),
+                                    aot_buckets=(512, 1024),
+                                    check_stability=True, verbose=True)
+    print(f"warmed {n_prog} programs in {time.perf_counter() - t0:.1f} s; "
+          f"a fresh process now loads all {grid_n * grid_n}-lane volcano "
           "programs from the persistent cache.")
 
 
