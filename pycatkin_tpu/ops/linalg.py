@@ -345,9 +345,17 @@ def make_mixed_solve(A: jnp.ndarray):
         # r is [n, 1], which broadcasts correctly over matrix RHS but
         # must be squeezed for vector RHS.
         bs = b * (r[..., 0] if b.ndim == r.ndim - 1 else r)
-        x = lu_solve(LU32, perm, bs.astype(jnp.float32)).astype(dtype)
-        res = bs - As @ x                        # f64 residual
+        # Magnitude-normalize the RHS (per column) before the f32 casts:
+        # equilibration absorbs A's row scaling but not b's size, so
+        # |bs| beyond ~3.4e38 would overflow the cast and residuals
+        # below f32's denormal floor would flush to zero. The system is
+        # linear -- scale to unit max, solve, undo on the way out.
+        bmax = jnp.max(jnp.abs(bs), axis=0)
+        bscale = jnp.where((bmax > 0) & jnp.isfinite(bmax), bmax, 1.0)
+        bn = bs / bscale
+        x = lu_solve(LU32, perm, bn.astype(jnp.float32)).astype(dtype)
+        res = bn - As @ x                        # f64 residual
         dx = lu_solve(LU32, perm, res.astype(jnp.float32)).astype(dtype)
-        return x + dx
+        return (x + dx) * bscale
 
     return solve_fn

@@ -80,7 +80,11 @@ class SolverOptions(NamedTuple):
     # after each Newton/PTC step, up to this many extra steps re-use the
     # SAME factorization (one residual + one triangular solve each -- no
     # new Jacobian/LU), kept only on strict residual decrease. Default
-    # OFF; the big-network bench/sweep configs turn it on. (A hardware-
+    # OFF; the big-network bench/sweep configs turn it on. At small n
+    # (<= linalg.UNROLL_MAX) the direction kernel stays the chord-off
+    # gauss_solve -- no factorization reuse, identical numerics to
+    # chord_steps=0; the Jacobian dominates the body cost there anyway.
+    # (A hardware-
     # f32 direction factorization was measured 2.4x faster but CANNOT
     # serve stiff kinetics: equilibrated PTC matrices carry cond
     # ~1e10-1e15, far beyond f32 refinement's ~1e7 ceiling -- the solver
@@ -115,18 +119,26 @@ def _direction_factor(A, opts: SolverOptions | None):
     """Factor the Newton/PTC matrix once, return a solve closure (the
     one site for direction-kernel dispatch; chord steps re-use it).
 
-    Always the full-precision arithmetic kernels (small n: one
-    Gauss-Jordan inverse, large n: sequential LU). Faster direction
-    kernels were measured and REJECTED for this site, recorded in
-    docs/perf_config5.md: XLA:TPU's native f32 LuDecomposition custom
-    call kernel-faults inside vmapped while_loops, and the refined
-    mixed-precision factorization (linalg.make_mixed_solve, 2.4x
-    faster at [128, 190, 190]) stalls the solve outright -- stiff
-    kinetics PTC matrices measure cond ~1e10-1e15 AFTER row
-    equilibration, beyond f32 refinement's ~1e7 contraction ceiling,
-    at every pseudo-time scale (the 1e-14 dt clip floor keeps I/dt
-    from ever dominating a ||J|| ~ 1e16+ Jacobian)."""
-    if opts is not None and opts.chord_steps > 0:
+    Always the full-precision arithmetic kernels (small n: equilibrated
+    Gauss-Jordan, large n: sequential LU). With chord steps enabled the
+    LARGE-n path factors once (LU) and re-uses the factorization per
+    chord; the SMALL-n path deliberately keeps the direct per-RHS
+    gauss_solve kernel -- chord-on and chord-off numerics then agree
+    exactly for ill-conditioned stiff small networks (an explicit-
+    inverse matvec is a different rounding path), and re-solving is
+    cheap at unrolled sizes where the Jacobian, not the solve,
+    dominates the body cost. Faster direction kernels were measured
+    and REJECTED for this site, recorded in docs/perf_config5.md:
+    XLA:TPU's native f32 LuDecomposition custom call kernel-faults
+    inside vmapped while_loops, and the refined mixed-precision
+    factorization (linalg.make_mixed_solve, 2.4x faster at
+    [128, 190, 190]) stalls the solve outright -- stiff kinetics PTC
+    matrices measure cond ~1e10-1e15 AFTER row equilibration, beyond
+    f32 refinement's ~1e7 contraction ceiling, at every pseudo-time
+    scale (the 1e-14 dt clip floor keeps I/dt from ever dominating a
+    ||J|| ~ 1e16+ Jacobian)."""
+    if (opts is not None and opts.chord_steps > 0
+            and A.shape[-1] > linalg.UNROLL_MAX):
         return linalg.make_msolve(A)
     return lambda b: linalg.solve(A, b)
 
@@ -200,23 +212,30 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         # evaluation + one triangular solve -- no Jacobian, no LU --
         # and is kept only on strict residual decrease, so a stale
         # direction can slow nothing down. The SER growth below then
-        # sees the full (Newton + chords) residual drop. The gross
-        # scale is FROZEN at the body's Newton point (gross_new): the
-        # yardstick moves smoothly with x, chord displacements are
-        # small, and not consuming gross_c lets XLA dead-code-eliminate
-        # the |S| matmul from every chord evaluation; the residual the
-        # attempt RETURNS is re-measured against a fresh scale below.
+        # sees the full (Newton + chords) residual drop. Each chord's
+        # accept test measures against the PREVIOUS accepted point's
+        # gross scale (comparable within the body; the yardstick moves
+        # smoothly with x), but the scale FOLLOWS the accepted iterate,
+        # and the body's outgoing residual is re-measured against the
+        # final point's own scale -- so the while_loop exit test, the
+        # verdict and the returned residual all use the same fresh
+        # yardstick and a borderline lane cannot exit "converged" only
+        # to fail the verdict and burn a full extra attempt.
         for _ in range(opts.chord_steps):
             dxc = solve_fn(F_new * (1.0 - M))
             x_c = _normalize(jnp.maximum(x_new + dxc, 0.0), groups_dyn,
                              opts.floor)
-            F_c, _ = fscale_fn(x_c)
+            F_c, gross_c = fscale_fn(x_c)
             f_c = _rnorm(F_c, gross_new, opts)
             take = (jnp.isfinite(f_c) & jnp.all(jnp.isfinite(x_c))
                     & (f_c < fnorm_new))
             x_new = jnp.where(take, x_c, x_new)
             F_new = jnp.where(take, F_c, F_new)
+            gross_new = jnp.where(take, gross_c, gross_new)
             fnorm_new = jnp.where(take, f_c, fnorm_new)
+        if opts.chord_steps > 0:
+            # Fresh-scale exit measure at the accepted point (see above).
+            fnorm_new = _rnorm(F_new, gross_new, opts)
         finite = jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
         # Accept steps that do not blow the residual up; a mild increase
         # is tolerated (transient phase of the pseudo-time march).
@@ -239,13 +258,9 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     f0 = _rnorm(F0, gross0, opts)
     x, F, dt, fnorm, k = jax.lax.while_loop(
         cond, body, (x0, F0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
-    if opts.chord_steps > 0:
-        # Chord accepts were judged against a frozen gross scale;
-        # re-measure the returned residual against the fresh one so the
-        # verdict downstream cannot inherit a stale yardstick. (One
-        # evaluation per ATTEMPT -- noise next to the loop's cost.)
-        Fx, grossx = fscale_fn(x)
-        fnorm = _rnorm(Fx, grossx, opts)
+    # With chord steps the carried fnorm is already measured against the
+    # accepted iterate's own gross scale (see the body), so no post-loop
+    # re-measure is needed and loop exit == verdict yardstick.
     return x, fnorm, k
 
 
