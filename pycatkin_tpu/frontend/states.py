@@ -108,6 +108,52 @@ class State:
             raise ValueError(f"gas state {self.name} requires a symmetry number")
 
     # ------------------------------------------------------------------
+    # construction from in-memory structure objects
+    @classmethod
+    def from_atoms(cls, name: str, atoms, state_type: str,
+                   sigma: Optional[float] = None, freq=None, i_freq=None,
+                   energy: Optional[float] = None, **kwargs) -> "State":
+        """Build a State from an in-memory ASE ``Atoms``(-like) object.
+
+        The reference reads structures through ASE and holds ``Atoms``
+        objects directly (reference state.py:77-105: ``get_atoms``
+        computes mass/inertia via ``atoms.get_masses()`` /
+        ``atoms.get_moments_of_inertia()``); this is the entry point for
+        users who already hold such an object instead of an
+        OUTCAR/log.vib tree. ASE itself is NOT required (and is not a
+        dependency): any object exposing ``get_masses()`` and -- for gas
+        states -- ``get_moments_of_inertia()`` (amu*A^2) works. The
+        electronic energy is taken from ``energy`` if given, else from
+        ``atoms.get_potential_energy()`` when the object has a
+        calculator attached (errors there are treated as "no energy",
+        matching a bare structure file).
+
+        ``freq``/``i_freq`` (Hz) seed the vibrational modes exactly like
+        input-file frequencies. The structure (symbols + positions) is
+        kept for :meth:`get_structure`/:meth:`save_pdb` when the object
+        exposes ``get_chemical_symbols()``/``get_positions()``.
+        """
+        mass = float(np.sum(np.asarray(atoms.get_masses(), dtype=float)))
+        inertia = None
+        if state_type == GAS:
+            inertia = np.asarray(atoms.get_moments_of_inertia(),
+                                 dtype=float)
+        if energy is None and hasattr(atoms, "get_potential_energy"):
+            try:
+                energy = float(atoms.get_potential_energy())
+            except Exception:      # no calculator attached -> no energy
+                energy = None
+        st = cls(name=name, state_type=state_type, sigma=sigma,
+                 mass=mass, inertia=inertia, freq=freq, i_freq=i_freq,
+                 Gelec=energy, **kwargs)
+        if (hasattr(atoms, "get_chemical_symbols")
+                and hasattr(atoms, "get_positions")):
+            st._structure = (list(atoms.get_chemical_symbols()),
+                             np.asarray(atoms.get_positions(),
+                                        dtype=float))
+        return st
+
+    # ------------------------------------------------------------------
     # data resolution
     def _set_inertia(self, inertia: np.ndarray):
         inertia = np.where(inertia > INERTIA_CUTOFF, inertia, 0.0)
@@ -225,7 +271,10 @@ class State:
 
     def get_structure(self):
         """(symbols, positions [A]) of the final ionic step, read from the
-        state's OUTCAR. None when the state has no structure source."""
+        state's OUTCAR (or kept from :meth:`from_atoms`). None when the
+        state has no structure source."""
+        if getattr(self, "_structure", None) is not None:
+            return self._structure
         if self.path is None:
             return None
         try:

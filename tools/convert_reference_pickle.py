@@ -1,0 +1,284 @@
+"""Convert reference-PyCatKin pickles into this framework's JSON schema.
+
+The reference persists every class as a pickle (state.py:24-29/431-443
+``state_*.pckl``, reaction.py:18-23/193-199 ``reaction_*.pckl``,
+old_system.py:24-29/641-647 ``system.pckl``, reactor.py:80-86); this
+framework checkpoints as reference-schema JSON (utils/io.py). This tool
+is the one-shot migration bridge for users holding existing reference
+pickles:
+
+    python tools/convert_reference_pickle.py system.pckl input.json
+    python tools/convert_reference_pickle.py state_CO.pckl CO.json
+
+The pickle is loaded WITHOUT importing the reference package (or ASE,
+whose Atoms objects ride inside state pickles): a restricted unpickler
+maps every non-allowlisted class to an attribute-bag shim, so (a) no
+reference code runs, (b) no third-party import is needed, and (c) no
+arbitrary class constructor executes during load. Only numpy scalars/
+arrays and core builtins deserialize as themselves.
+
+Resolved data is preferred over paths: a pickled state that already
+carries Gelec/freq (the common case -- reference objects resolve their
+DFT sources before anyone pickles them) converts to an inlined,
+path-free JSON state; unresolved fields fall back to the recorded
+path/vibs_path + source keys so the JSON loads through the ordinary
+file readers.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import sys
+
+import numpy as np
+
+# Modules whose classes deserialize as themselves. Everything else --
+# pycatkin.*, ase.*, arbitrary user modules -- becomes a _Shim subclass
+# carrying only the pickled __dict__/state.
+_ALLOWED_MODULES = ("numpy", "builtins", "collections", "__builtin__")
+
+
+class _Shim:
+    """Attribute bag standing in for a reference (or ASE) class."""
+
+    def __init__(self, *args, **kwargs):
+        self._shim_args = args
+        self._shim_kwargs = kwargs
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        elif isinstance(state, tuple) and len(state) == 2:
+            # (dict_state, slots_state) protocol
+            for part in state:
+                if isinstance(part, dict):
+                    self.__dict__.update(part)
+        else:
+            self.__dict__["_shim_state"] = state
+
+
+class _RefUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        root = module.split(".")[0]
+        if root in _ALLOWED_MODULES:
+            return super().find_class(module, name)
+        return type(name, (_Shim,), {"__module__": module})
+
+
+def load_reference_pickle(path: str):
+    """Load a reference pickle as a shim object graph (no reference/ASE
+    imports, no reference code execution)."""
+    with open(path, "rb") as fh:
+        return _RefUnpickler(io.BytesIO(fh.read())).load()
+
+
+def _f(v):
+    """JSON-safe scalar."""
+    if v is None:
+        return None
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    return v
+
+
+def _name_of(obj):
+    return obj if isinstance(obj, str) else getattr(obj, "name", None)
+
+
+def _is_state(obj):
+    return getattr(obj, "state_type", None) is not None
+
+
+def _is_scaling_state(obj):
+    return getattr(obj, "scaling_coeffs", None) is not None
+
+
+def _is_reaction(obj):
+    return getattr(obj, "reac_type", None) is not None
+
+
+def _is_system(obj):
+    return (isinstance(getattr(obj, "states", None), dict)
+            and isinstance(getattr(obj, "reactions", None), dict))
+
+
+def state_to_cfg(st) -> dict:
+    """Reference State/ScalingState shim -> JSON state config (the keys
+    utils/io._state_cfg writes and frontend/loader reads)."""
+    cfg = {"state_type": st.state_type}
+    for key in ("sigma", "mass"):
+        if getattr(st, key, None) is not None:
+            cfg[key] = _f(getattr(st, key))
+    if getattr(st, "inertia", None) is not None:
+        cfg["inertia"] = [float(x) for x in np.ravel(st.inertia)]
+    freq = getattr(st, "freq", None)
+    if freq is not None and np.size(freq):
+        cfg["freq"] = [float(x) for x in np.ravel(freq)]
+        i_freq = getattr(st, "i_freq", None)
+        if i_freq is not None and np.size(i_freq):
+            cfg["i_freq"] = [float(x) for x in np.ravel(i_freq)]
+    for key in ("Gelec", "Gzpe", "Gvibr", "Gtran", "Grota", "Gfree"):
+        if getattr(st, key, None) is not None:
+            cfg[key] = _f(getattr(st, key))
+    if getattr(st, "add_to_energy", None):
+        cfg["add_to_energy"] = _f(st.add_to_energy)
+    if getattr(st, "truncate_freq", True) is False:
+        cfg["truncate_freq"] = False
+    # Unresolved sources fall back to the recorded file paths.
+    if "Gelec" not in cfg and getattr(st, "path", None):
+        cfg["path"] = st.path
+        if getattr(st, "energy_source", None):
+            cfg["energy_source"] = st.energy_source
+    if "freq" not in cfg and getattr(st, "vibs_path", None):
+        cfg["vibs_path"] = st.vibs_path
+        if getattr(st, "freq_source", None):
+            cfg["freq_source"] = st.freq_source
+    gasdata = getattr(st, "gasdata", None)
+    if gasdata:
+        cfg["gasdata"] = {
+            "fraction": [_f(x) for x in gasdata["fraction"]],
+            "state": [_name_of(s) for s in gasdata["state"]],
+        }
+    if _is_scaling_state(st):
+        cfg["scaling_coeffs"] = {k: _f(v)
+                                 for k, v in st.scaling_coeffs.items()} \
+            if isinstance(st.scaling_coeffs, dict) else st.scaling_coeffs
+        sr = {}
+        for key, entry in getattr(st, "scaling_reactions", {}).items():
+            e = {"reaction": _name_of(entry["reaction"])}
+            if "multiplicity" in entry:
+                e["multiplicity"] = _f(entry["multiplicity"])
+            sr[key] = e
+        cfg["scaling_reactions"] = sr
+        if getattr(st, "dereference", False):
+            cfg["dereference"] = True
+        if getattr(st, "use_descriptor_as_reactant", False):
+            cfg["use_descriptor_as_reactant"] = True
+    return cfg
+
+
+def reaction_to_cfg(rx) -> dict:
+    """Reference Reaction shim -> JSON reaction config."""
+    cfg = {"reac_type": rx.reac_type,
+           "reactants": [_name_of(s) for s in (rx.reactants or [])],
+           "products": [_name_of(s) for s in (rx.products or [])]}
+    ts = getattr(rx, "TS", None)
+    cfg["TS"] = [_name_of(s) for s in ts] if ts else None
+    if getattr(rx, "area", None) is not None:
+        cfg["area"] = _f(rx.area)
+    if getattr(rx, "reversible", True) is False:
+        cfg["reversible"] = False
+    if getattr(rx, "scaling", 1.0) != 1.0:
+        cfg["scaling"] = _f(rx.scaling)
+    base = getattr(rx, "base_reaction", None)
+    if base is not None:
+        cfg["base_reaction"] = _name_of(base)
+    for key in ("dErxn_user", "dGrxn_user", "dEa_fwd_user",
+                "dGa_fwd_user", "dEa_rev_user", "dGa_rev_user"):
+        val = getattr(rx, key, None)
+        if val is not None:
+            cfg[key] = ({str(k): _f(v) for k, v in val.items()}
+                        if isinstance(val, dict) else _f(val))
+    return cfg
+
+
+def _reactor_cfg(reactor):
+    if reactor is None:
+        return "InfiniteDilutionReactor"
+    kind = type(reactor).__name__
+    if kind == "InfiniteDilutionReactor":
+        return "InfiniteDilutionReactor"
+    body = {}
+    for key in ("residence_time", "volume", "catalyst_area", "flow_rate"):
+        if getattr(reactor, key, None) is not None:
+            body[key] = _f(getattr(reactor, key))
+    return {kind: body}
+
+
+def _json_safe(v):
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return [_json_safe(x) for x in v.tolist()]
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def system_to_input(sys_shim) -> dict:
+    """Reference (old_)System shim -> full JSON input dict (sections:
+    states / scaling relation states / reactions / reactor / system)."""
+    out = {"states": {}, "reactions": {}}
+    scaling = {}
+    for name, st in sys_shim.states.items():
+        cfg = state_to_cfg(st)
+        if _is_scaling_state(st):
+            scaling[name] = cfg
+        else:
+            out["states"][name] = cfg
+    if scaling:
+        out["scaling relation states"] = scaling
+    derived = {}
+    for name, rx in sys_shim.reactions.items():
+        cfg = reaction_to_cfg(rx)
+        if "base_reaction" in cfg:
+            derived[name] = cfg
+        else:
+            out["reactions"][name] = cfg
+    if derived:
+        out["reaction derived reactions"] = derived
+    out["reactor"] = _reactor_cfg(getattr(sys_shim, "reactor", None))
+    params = getattr(sys_shim, "params", None)
+    if params:
+        out["system"] = {k: _json_safe(v) for k, v in params.items()
+                         if _json_safe(v) is not None
+                         or v is None}
+    return out
+
+
+def convert(obj) -> dict:
+    """Dispatch on the pickled object kind. A bare State/Reaction
+    converts to a single-section snippet keyed by its name."""
+    if _is_system(obj):
+        return system_to_input(obj)
+    if _is_state(obj):
+        name = getattr(obj, "name", "state")
+        key = ("scaling relation states" if _is_scaling_state(obj)
+               else "states")
+        return {key: {name: state_to_cfg(obj)}}
+    if _is_reaction(obj):
+        name = getattr(obj, "name", "reaction")
+        key = ("reaction derived reactions"
+               if getattr(obj, "base_reaction", None) is not None
+               else "reactions")
+        return {key: {name: reaction_to_cfg(obj)}}
+    raise ValueError(
+        f"unrecognized reference pickle payload: {type(obj).__name__} "
+        "(expected a System, State or Reaction)")
+
+
+def main(argv):
+    if len(argv) not in (2, 3):
+        print("usage: python tools/convert_reference_pickle.py "
+              "<reference.pckl> [out.json]", file=sys.stderr)
+        return 2
+    src = argv[1]
+    obj = load_reference_pickle(src)
+    doc = convert(obj)
+    text = json.dumps(doc, indent=1)
+    if len(argv) == 3:
+        with open(argv[2], "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {argv[2]} ({type(obj).__name__} -> "
+              f"{', '.join(doc.keys())})", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
