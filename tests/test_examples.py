@@ -25,6 +25,24 @@ def _load_example(name):
     return mod
 
 
+def _exec_notebook(name):
+    """Execute a walkthrough notebook's code cells top-to-bottom in one
+    namespace (no jupyter dependency, headless matplotlib) and return
+    the final namespace for assertions."""
+    import json
+
+    import matplotlib
+    matplotlib.use("Agg")
+
+    with open(os.path.join(EXAMPLES_DIR, f"{name}.ipynb")) as fh:
+        nb = json.load(fh)
+    ns = {}
+    for cell in nb["cells"]:
+        if cell["cell_type"] == "code":
+            exec("".join(cell["source"]), ns)
+    return ns
+
+
 @pytest.mark.slow
 def test_dmtm_example(ref_root, tmp_path):
     """DMTM workflow end-to-end: landscapes, transient, T-sweep with DRC,
@@ -133,17 +151,7 @@ def test_dmtm_walkthrough_notebook(ref_root):
     examples/DMTM/dmtm.ipynb) executes top-to-bottom: code cells are
     exec'd in one namespace (no jupyter dependency), and the headline
     results hold (steady success, DRC argmax r9)."""
-    import json
-
-    import matplotlib
-    matplotlib.use("Agg")
-
-    with open(os.path.join(EXAMPLES_DIR, "dmtm_walkthrough.ipynb")) as fh:
-        nb = json.load(fh)
-    ns = {}
-    for cell in nb["cells"]:
-        if cell["cell_type"] == "code":
-            exec("".join(cell["source"]), ns)
+    ns = _exec_notebook("dmtm_walkthrough")
     assert bool(ns["res"].success)
     assert ns["top"][0][0] == "r9"
     assert np.all(np.asarray(ns["out"]["success"]))
@@ -155,19 +163,8 @@ def test_cooxreactor_walkthrough_notebook(ref_root, tmp_path, monkeypatch):
     examples/COOxReactor/cooxreactor.ipynb) executes top-to-bottom
     headless and reproduces the 51.143 % golden conversion at 523 K
     (its own final cell asserts it; re-checked here)."""
-    import json
-
-    import matplotlib
-    matplotlib.use("Agg")
     monkeypatch.chdir(tmp_path)     # notebook writes examples/out/...
-
-    with open(os.path.join(EXAMPLES_DIR,
-                           "cooxreactor_walkthrough.ipynb")) as fh:
-        nb = json.load(fh)
-    ns = {}
-    for cell in nb["cells"]:
-        if cell["cell_type"] == "code":
-            exec("".join(cell["source"]), ns)
+    ns = _exec_notebook("cooxreactor_walkthrough")
     assert ns["x523"] == pytest.approx(51.143, abs=1e-2)
     assert set(ns["conv"]) == {"AuPd", "Pd111"}
     assert os.path.isfile(os.path.join(

@@ -1,0 +1,97 @@
+"""Round-5 experiment: warm-started CH4 single-solve latency ladder.
+
+VERDICT r4 item 4: the unseeded solve pays a ~43-iteration PTC ramp
+(18.5 ms marginal) as the price of landing on the physical root; a
+warm-started solve (seeded from a neighboring solution, near-Newton
+pacing) should approach scipy's ~2-3 ms. This measures the marginal
+device latency of seeded solves at several pacing configurations and
+T-step densities, by the chain-differencing method of bench_suite
+config 1 (data-dependent chained solves, one scalar fence).
+
+Run on the TPU:  python tools/exp_warm_start.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pycatkin_tpu as pk
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.solvers.newton import SolverOptions
+
+    sim = pk.read_from_input_file("/root/reference/test/CH4_input.json")
+    spec, cond = sim.spec, sim.conditions()
+    dyn = jnp.asarray(spec.dynamic_indices)
+    print(f"n_dyn = {len(spec.dynamic_indices)}", file=sys.stderr)
+
+    # Physical root at the base T (untimed): PTC from the start state
+    # lands on it (pinned by tests/test_ch4.py).
+    base = engine.steady_state(spec, cond)
+    assert bool(base.success)
+    x_star = jnp.asarray(base.x)[dyn]
+
+    def chain(c, n, opts, dT):
+        def body(carry, _):
+            T, x = carry
+            res = engine.steady_state(spec, c._replace(T=T), x0=x,
+                                      opts=opts)
+            return (T + dT + res.x[0] * 1e-12, res.x[dyn]), \
+                (res.success, res.iterations)
+        (_, x_last), (succ, iters) = jax.lax.scan(
+            body, (c.T, x_star), None, length=n)
+        return jnp.sum(x_last) + jnp.sum(succ), succ, iters
+
+    configs = {
+        "default": SolverOptions(),
+        "newton": SolverOptions(dt0=1e6, dt_grow_min=30.0,
+                                max_steps=60, max_attempts=1),
+        "newton+chord2": SolverOptions(dt0=1e6, dt_grow_min=30.0,
+                                       max_steps=60, max_attempts=1,
+                                       chord_steps=2),
+        "dt0=1": SolverOptions(dt0=1.0, dt_grow_min=10.0,
+                               max_steps=60, max_attempts=1),
+    }
+    for dT in (0.01, 1.0, 5.0):
+        for name, opts in configs.items():
+            c1 = jax.jit(lambda c, o=opts, d=dT: chain(c, 1, o, d))
+            c13 = jax.jit(lambda c, o=opts, d=dT: chain(c, 13, o, d))
+            # compile untimed
+            np.asarray(c1(cond._replace(T=cond.T + 0.3))[0])
+            np.asarray(c13(cond._replace(T=cond.T + 0.4))[0])
+            rng = np.random.default_rng(0)
+            marg, its = [], None
+            for _ in range(3):
+                cT = cond._replace(T=cond.T + rng.uniform(0, .01))
+                t0 = time.perf_counter()
+                f, s1, _ = c1(cT)
+                float(np.asarray(f))
+                w1 = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                f, s13, it13 = c13(cT)
+                float(np.asarray(f))
+                w13 = time.perf_counter() - t0
+                marg.append((w13 - w1) / 12.0)
+                its = it13
+                ok = bool(np.all(np.asarray(s13)))
+            m = sorted(marg)[1]
+            print(f"dT={dT:5.2f} {name:14s}: {m*1e3:7.2f} ms/solve "
+                  f"(min {min(marg)*1e3:.2f}, max {max(marg)*1e3:.2f}), "
+                  f"iters={np.asarray(its).tolist()} ok={ok}")
+
+
+if __name__ == "__main__":
+    main()
