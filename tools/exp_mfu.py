@@ -1,0 +1,171 @@
+"""Round-5 MFU / roofline measurement for docs/perf_mfu.md.
+
+For bench configs 4 (COOx volcano 256x256, n_dyn=4) and 5 (synthetic
+200x500, n_dyn=190): run the exact fast-pass solver program, read the
+per-lane iteration counts, and divide the fenced wall by the union
+iteration count (a vmapped while_loop executes the union of all lanes'
+work, so wall ~= max_iters x per-iteration kernel time). Combined with
+the analytic per-iteration FLOP/byte model (printed here from the spec
+shapes) and tools/exp_roofline.py's measured ceilings, this pins where
+each config sits on the roofline.
+
+Run on the TPU:  python tools/exp_mfu.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+
+def flops_per_iteration(n_s, n_r, n_dyn, n_reac_cols, chords=0):
+    """Analytic logical-f64 FLOPs per PTC body per lane.
+
+    residual eval: fwd+rev flux products (~2*n_r*n_reac_cols mul) +
+    2 stoich matvecs (net + gross, 2*2*n_s*n_r) ~= R
+    jacobian: n_dyn JVPs ~= n_dyn * R (jacfwd)
+    direction solve: Gauss-Jordan ~2*n_dyn^3 (small n) or LU 2/3 n^3 +
+    chords * 2*n_dyn^2 triangular solves
+    projection/verdict/SER: ~10*n_dyn
+    """
+    R = 2 * n_r * n_reac_cols + 2 * 2 * n_s * n_r
+    jac = n_dyn * R
+    solve = 2 * n_dyn ** 3 if n_dyn <= 48 else (2 / 3) * n_dyn ** 3
+    chord = chords * (2 * n_dyn ** 2 + R)
+    return R + jac + solve + chord + 10 * n_dyn
+
+
+def fenced(prog, *args):
+    import jax.numpy as jnp
+    t0 = time.perf_counter()
+    out = prog(*args)
+    float(np.asarray(jnp.sum(out.residual) + jnp.sum(out.iterations)))
+    return time.perf_counter() - t0, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pycatkin_tpu as pk
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.models import coox
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel import batch
+    from pycatkin_tpu.parallel.batch import (_fast_pass_opts,
+                                             _steady_program,
+                                             broadcast_conditions)
+    from pycatkin_tpu.solvers.newton import SolverOptions
+
+    results = {}
+
+    # ---- config 4: COOx volcano fast pass at 256x256 ----
+    sim = pk.read_from_input_file(
+        "/root/reference/examples/COOxVolcano/input.json")
+    spec = sim.spec
+    be = np.linspace(-2.5, 0.5, 256)
+    conds, _ = coox.volcano_grid_conditions(sim, be)
+    conds = jax.tree_util.tree_map(jnp.asarray, conds)
+    n = 256 * 256
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    prog = _steady_program(spec, _fast_pass_opts(SolverOptions()))
+    fenced(prog, conds, keys, None)              # warm
+    walls = []
+    for i in range(3):
+        w, out = fenced(prog, conds._replace(T=conds.T + 1e-7 * (i + 1)),
+                        keys, None)
+        walls.append(w)
+    wall = sorted(walls)[1]
+    iters = np.asarray(out.iterations)
+    it_max, it_mean = int(iters.max()), float(iters.mean())
+    n_s, n_r, n_dyn = len(spec.snames), len(spec.rnames), \
+        len(spec.dynamic_indices)
+    fl = flops_per_iteration(n_s, n_r, n_dyn, spec.reac_idx.shape[1])
+    results["config4"] = {
+        "lanes": n, "n_s": n_s, "n_r": n_r, "n_dyn": n_dyn,
+        "fast_pass_wall_s": round(wall, 3),
+        "iters_max": it_max, "iters_mean": round(it_mean, 1),
+        "per_iter_ms": round(wall / it_max * 1e3, 2),
+        "flops_per_iter_lane": round(fl),
+        "logical_f64_flops_total": round(fl * float(iters.sum())),
+        "achieved_logical_f64_flops": round(fl * float(iters.sum())
+                                            / wall),
+        # union-of-lanes accounting: the vmapped while_loop executes
+        # it_max iterations for EVERY lane (finished lanes masked)
+        "union_f64_flops": round(fl * it_max * n),
+        "achieved_union_f64_flops": round(fl * it_max * n / wall),
+    }
+    print(f"[4] wall {wall:.3f} s, iters max {it_max} mean {it_mean:.1f}, "
+          f"per-union-iter {wall/it_max*1e3:.1f} ms, "
+          f"{fl:.0f} flop/iter/lane -> "
+          f"{fl*it_max*n/wall/1e9:.2f} Gflop64/s (union)",
+          file=sys.stderr)
+
+    # carry state HBM traffic per union iteration: x, F, dt, fnorm, k
+    # (f64 = 2xf32 pairs, 16 B per logical value) read+written, plus
+    # J assembly scratch.
+    carry_vals = n * (2 * n_dyn + n_s + 3)
+    bytes_per_iter = 2 * 16 * carry_vals
+    results["config4"]["approx_carry_GBps"] = round(
+        bytes_per_iter * it_max / wall / 1e9, 2)
+
+    # ---- config 5: synthetic 200x500 with chord pacing at 128 lanes --
+    sim5 = synthetic_system(n_species=200, n_reactions=500, seed=0)
+    spec5 = sim5.spec
+    n5 = 128
+    opts5 = SolverOptions(dt0=100.0, dt_grow_min=30.0, chord_steps=4)
+    Ts = np.linspace(420.0, 700.0, 8)
+    ps = np.logspace(4.0, 6.0, 4)
+    dEs = np.linspace(-0.15, 0.15, 4)
+    TT, PP, EE = np.meshgrid(Ts, ps, dEs, indexing="ij")
+    base = sim5.conditions()
+    eps = np.zeros((n5, len(spec5.snames)))
+    eps[:, spec5.is_adsorbate.astype(bool)] = EE.ravel()[:, None]
+    conds5 = broadcast_conditions(base, n5)._replace(
+        T=jnp.asarray(TT.ravel()), p=jnp.asarray(PP.ravel()),
+        eps=jnp.asarray(eps))
+    keys5 = jax.random.split(jax.random.PRNGKey(0), n5)
+    prog5 = _steady_program(spec5, _fast_pass_opts(opts5))
+    fenced(prog5, conds5, keys5, None)           # warm
+    walls5 = []
+    for i in range(3):
+        w, out5 = fenced(prog5,
+                         conds5._replace(T=conds5.T + 1e-7 * (i + 1)),
+                         keys5, None)
+        walls5.append(w)
+    wall5 = sorted(walls5)[1]
+    iters5 = np.asarray(out5.iterations)
+    it5_max, it5_mean = int(iters5.max()), float(iters5.mean())
+    n_s5, n_r5, n_dyn5 = len(spec5.snames), len(spec5.rnames), \
+        len(spec5.dynamic_indices)
+    fl5 = flops_per_iteration(n_s5, n_r5, n_dyn5,
+                              spec5.reac_idx.shape[1], chords=4)
+    results["config5"] = {
+        "lanes": n5, "n_s": n_s5, "n_r": n_r5, "n_dyn": n_dyn5,
+        "fast_pass_wall_s": round(wall5, 3),
+        "iters_max": it5_max, "iters_mean": round(it5_mean, 1),
+        "per_iter_ms": round(wall5 / it5_max * 1e3, 2),
+        "flops_per_iter_lane": round(fl5),
+        "union_f64_flops": round(fl5 * it5_max * n5),
+        "achieved_union_f64_flops": round(fl5 * it5_max * n5 / wall5),
+    }
+    print(f"[5] wall {wall5:.3f} s, iters max {it5_max} mean "
+          f"{it5_mean:.1f}, per-union-iter {wall5/it5_max*1e3:.1f} ms, "
+          f"{fl5/1e6:.2f} Mflop/iter/lane -> "
+          f"{fl5*it5_max*n5/wall5/1e9:.2f} Gflop64/s (union)",
+          file=sys.stderr)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

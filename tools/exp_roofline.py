@@ -1,0 +1,118 @@
+"""Round-5 roofline microbenchmarks for docs/perf_mfu.md.
+
+Measures this chip's practical ceilings for the operation classes the
+steady-state solver actually spends time in. Every measurement chains
+K dependent iterations of the kernel inside ONE jitted fori_loop (loop
+carries force one kernel pass per iteration -- no cross-iteration
+fusion) so device time dwarfs the ~0.1 s tunnel round trip, then
+fences through a scalar materialization.
+
+  1. bf16 / f32 / emulated-f64 batched matmul (MXU + the Jacobian/LU
+     arithmetic class) at the config-5 shape [128, 190, 190]
+  2. emulated-f64 elementwise exp (the rate-constant class)
+  3. emulated-f64 / f32 elementwise fma chain (the PTC update class)
+  4. HBM streaming bandwidth (elementwise scale pass over f64)
+
+Run on the TPU:  python tools/exp_roofline.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.utils.cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import numpy as np  # noqa: E402
+
+
+def timed_loop(body, x0, k, trials=3):
+    """Median fenced wall of ONE program running `body` k times in a
+    fori_loop (data-dependent carry)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def prog(x):
+        y = jax.lax.fori_loop(0, k, lambda i, y: body(y), x)
+        return jnp.sum(y.astype(jnp.float32))
+
+    float(np.asarray(prog(x0)))              # compile + warm
+    walls = []
+    for i in range(trials):
+        x = x0 + np.float32(1e-6 * (i + 1)).astype(x0.dtype)
+        t0 = time.perf_counter()
+        float(np.asarray(prog(x)))
+        walls.append(time.perf_counter() - t0)
+    return sorted(walls)[1]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    results = {}
+
+    # batched matmul [B, n, n] @ [B, n, n] -- config-5 Jacobian scale
+    B, n = 128, 190
+    flops = 2 * B * n * n * n
+    for dtype, name, k in ((jnp.bfloat16, "bf16", 2048),
+                           (jnp.float32, "f32", 512),
+                           (jnp.float64, "f64emu", 64)):
+        A = jnp.asarray(np.random.default_rng(0).normal(size=(B, n, n)),
+                        dtype=dtype)
+        Bm = jnp.asarray(
+            np.random.default_rng(1).normal(size=(B, n, n)) / n,
+            dtype=dtype)
+        w = timed_loop(lambda y, Bm=Bm: y @ Bm, A, k) / k
+        results[f"matmul_{name}"] = flops / w
+        print(f"matmul[{B},{n},{n}] {name}: {w*1e3:9.3f} ms/iter  "
+              f"{flops/w/1e12:8.3f} Tflop/s", file=sys.stderr)
+
+    # elementwise exp, f64 emulation (rate constants / equilibrium)
+    N = 1 << 24
+    x = jnp.asarray(np.random.default_rng(2).uniform(-1, 1, N),
+                    dtype=jnp.float64)
+    w = timed_loop(lambda y: jnp.exp(y * 0.5) - 1.0, x, 32) / 32
+    results["exp_f64emu"] = N / w
+    print(f"exp f64emu [{N}]: {w*1e3:9.3f} ms/iter  "
+          f"{N/w/1e9:6.2f} Gexp/s", file=sys.stderr)
+
+    # elementwise fma chain (PTC update arithmetic): 16 dependent fmas
+    # per loop iteration
+    k_in = 16
+
+    def fma_body(y):
+        for _ in range(k_in):
+            y = y * 1.0000001 + 1e-9
+        return y
+
+    for dtype, name in ((jnp.float64, "f64emu"), (jnp.float32, "f32")):
+        xd = x.astype(dtype)
+        w = timed_loop(fma_body, xd, 64) / 64
+        results[f"fma_{name}"] = 2 * k_in * N / w
+        print(f"fma-chain {name} [{N}x{k_in}]: {w*1e3:9.3f} ms/iter  "
+              f"{2*k_in*N/w/1e9:6.2f} Gflop/s", file=sys.stderr)
+
+    # HBM streaming: one multiply pass over f64 = read+write 2x16 B per
+    # logical element (f64 emulation stores hi/lo f32 pairs... the jax
+    # x64 array on this backend is 8 B storage; count 8 B in + 8 B out)
+    w = timed_loop(lambda y: y * 1.0000001, x, 256) / 256
+    bytes_moved = 2 * 8 * N
+    results["hbm_stream"] = bytes_moved / w
+    print(f"f64 stream [{N}]: {w*1e3:9.3f} ms/iter  "
+          f"{bytes_moved/w/1e9:6.1f} GB/s", file=sys.stderr)
+
+    import json
+    print(json.dumps({k: float(v) for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()
