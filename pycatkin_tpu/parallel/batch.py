@@ -360,9 +360,74 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     return certified
 
 
+def _neighbor_seed_lanes(conds: Conditions, success: np.ndarray):
+    """For each failed lane, the index of the nearest CONVERGED lane in
+    (z-scored) condition space, or None when unavailable.
+
+    Failed lanes cluster along physical boundaries (phase transitions,
+    bistable regions); their own final iterates are the worst possible
+    restart points (measured on the 256x256 volcano's 269 such lanes:
+    up to 6 ladder attempts / 1091 accumulated iterations), while the
+    converged solution ONE grid step away is a near-root seed the very
+    same rescue program polishes in <=2 attempts / 216 iterations (the
+    reference's own sweep-continuation pattern, presets.py
+    run_temperatures).
+    Distance uses every condition leaf that varies across lanes
+    (descriptor energies, T, p, eps, ...), z-scored per feature; the
+    kd-tree query on the host costs milliseconds at volcano scale.
+    """
+    n = len(success)
+    fail_idx = np.flatnonzero(~success)
+    ok_idx = np.flatnonzero(success)
+    if len(ok_idx) == 0 or len(fail_idx) == 0:
+        return None
+    # ONE batched device->host transfer for the whole pytree (a
+    # per-leaf np.asarray loop would pay a tunnel round trip per leaf
+    # -- the very cost class this rescue path is optimized against).
+    host_conds = call_with_backend_retry(jax.device_get, conds,
+                                         label="neighbor-seed transfer")
+    feats = []
+    for a in jax.tree_util.tree_leaves(host_conds):
+        a = np.asarray(a)
+        if a.ndim >= 1 and a.shape[0] == n:
+            f = a.reshape(n, -1).astype(np.float64)
+            std = f.std(axis=0)
+            varying = std > 0
+            if varying.any():
+                f = f[:, varying]
+                feats.append((f - f.mean(axis=0)) / std[varying])
+    if not feats:
+        return None
+    X = np.concatenate(feats, axis=1)
+    try:
+        from scipy.spatial import cKDTree
+        _, nn = cKDTree(X[ok_idx]).query(X[fail_idx])
+    except ImportError:       # minimal installs: scipy is an extra
+        nn = _chunked_nearest(X[fail_idx], X[ok_idx])
+    out = np.arange(n)
+    out[fail_idx] = ok_idx[nn]
+    return out
+
+
+def _chunked_nearest(Xf: np.ndarray, Xo: np.ndarray,
+                     chunk: int = 128) -> np.ndarray:
+    """argmin_j |Xf_i - Xo_j| per row, via chunked
+    |a-b|^2 = |a|^2 + |b|^2 - 2ab -- memory stays O(chunk x n_ok)
+    instead of a dense 3-D difference tensor (a 512x512 grid's
+    failed-vs-converged difference tensor would be multiple GB)."""
+    o2 = (Xo * Xo).sum(axis=1)
+    nn = np.empty(len(Xf), dtype=np.int64)
+    for s in range(0, len(Xf), chunk):
+        f = Xf[s:s + chunk]
+        d = (f * f).sum(axis=1)[:, None] + o2[None, :] - 2.0 * (f @ Xo.T)
+        nn[s:s + chunk] = np.argmin(d, axis=1)
+    return nn
+
+
 def _rescue(spec: ModelSpec, conds: Conditions, res,
             opts: SolverOptions, strategy: str, pad_to: int = 64,
-            seed: int = 1, use_x0: bool = True):
+            seed: int = 1, use_x0: bool = True,
+            neighbor_seed: bool = False, n_failed: int | None = None):
     """Host-side second pass over FAILED lanes only: re-solve the failed
     subset with the given strategy/options from the best iterates of the
     first pass. Padded to a multiple of ``pad_to`` so recompiles stay
@@ -373,17 +438,35 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     ``use_x0=False`` restarts from the base state + PRNG random guesses
     instead of each lane's best iterate -- required when the iterate
     itself is the problem (a converged-but-UNSTABLE root: re-seeding on
-    it would reconverge with zero residual immediately)."""
-    # Scalar pre-check first: on the tunneled backend every
-    # materialization call costs ~0.8-1.2 s regardless of payload, so
-    # the full mask crosses to the host only when lanes actually failed
+    it would reconverge with zero residual immediately).
+
+    ``neighbor_seed=True`` seeds each failed lane from the nearest
+    CONVERGED lane's solution instead of its own failed iterate (see
+    :func:`_neighbor_seed_lanes`); the retry ladder's later attempts
+    (renormalize, random restarts) still back the seed up, so a bad
+    neighbor costs nothing vs the old behavior.
+
+    ``n_failed``: the caller's already-materialized failed-lane count
+    (skips this function's scalar pre-check round trip -- each
+    materialization call costs ~0.1-1 s on the tunneled backend).
+    Returns ``(res, n_remaining)`` with the post-rescue failed count,
+    so chained rescue passes never re-materialize it."""
+    # Scalar pre-check (only when the caller didn't already know): the
+    # full mask crosses to the host only when lanes actually failed
     # (the common volcano case is zero failures -> one cheap scalar).
-    if int(np.asarray(jnp.sum(~jnp.asarray(res.success)))) == 0:
-        return res
-    fail = ~np.asarray(res.success)
-    idx = np.flatnonzero(fail)
+    if n_failed is None:
+        n_failed = int(np.asarray(jnp.sum(~jnp.asarray(res.success))))
+    if n_failed == 0:
+        return res, 0
+    success = np.asarray(res.success)
+    idx = np.flatnonzero(~success)
     sub, idx_p = _padded_subset(conds, idx, bucket=pad_to)
-    x0 = (jnp.asarray(res.x)[idx_p][:, jnp.asarray(spec.dynamic_indices)]
+    seed_lane = idx_p
+    if use_x0 and neighbor_seed:
+        nn = _neighbor_seed_lanes(conds, success)
+        if nn is not None:
+            seed_lane = nn[idx_p]
+    x0 = (jnp.asarray(res.x)[seed_lane][:, jnp.asarray(spec.dynamic_indices)]
           if use_x0 else None)
     keys = jax.random.split(jax.random.PRNGKey(seed), len(idx_p))
 
@@ -399,8 +482,9 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
 
     out, got = call_with_backend_retry(run_rescue,
                                        label=f"rescue[{strategy}]")
+    n_remaining = int(n_failed - got.sum())
     if not got.any():
-        return res
+        return res, n_remaining
     x = np.array(res.x)
     succ = np.array(res.success)
     resid = np.array(res.residual)
@@ -416,7 +500,7 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     return res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
                         residual=jnp.asarray(resid),
                         iterations=jnp.asarray(iters),
-                        attempts=jnp.asarray(atts))
+                        attempts=jnp.asarray(atts)), n_remaining
 
 
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
@@ -455,9 +539,19 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     (used by both sweep_steady_state and continuation_sweep)."""
     # One scalar round trip decides both rescue phases (each
     # materialization call costs ~0.1-1 s on the tunneled backend).
-    if int(np.asarray(jnp.sum(~jnp.asarray(res.success)))) > 0:
-        res = _rescue(spec, conds, res, opts, "ptc")
-        res = _rescue(spec, conds, res, opts, "lm")
+    # The first rescue seeds from converged NEIGHBORS (continuation):
+    # measured on the 256x256 volcano's 269 phase-boundary lanes, the
+    # ladder needs max 2 attempts / 216 accumulated iterations with
+    # neighbor seeds vs 6 attempts / 1091 iterations from the lanes'
+    # own failed iterates -- 5x less union work through the SAME
+    # compiled program (the warm wall is latency-bound at this bucket
+    # width, ~2 s either way; the headroom pays on harder grids).
+    nf = int(np.asarray(jnp.sum(~jnp.asarray(res.success))))
+    if nf > 0:
+        res, nf = _rescue(spec, conds, res, opts, "ptc",
+                          neighbor_seed=True, n_failed=nf)
+    if nf > 0:
+        res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf)
     if check_stability:
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
                                 ok=res.success)
@@ -476,8 +570,8 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                 break
             res = res._replace(
                 success=jnp.asarray(res.success) & stable)
-            res = _rescue(spec, conds, res, opts, "ptc",
-                          seed=17 + round_i, use_x0=False)
+            res, _ = _rescue(spec, conds, res, opts, "ptc",
+                             seed=17 + round_i, use_x0=False)
             stable = stability_mask(spec, conds, res.x,
                                     pos_tol=pos_jac_tol,
                                     ok=res.success)

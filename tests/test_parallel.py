@@ -143,3 +143,38 @@ def test_continuation_sweep_matches_plain(volcano):
     np.testing.assert_allclose(np.asarray(cont["activity"]),
                                np.asarray(plain["activity"]),
                                rtol=0, atol=2e-2)
+
+
+def test_neighbor_seed_lanes_mapping(volcano):
+    """The continuation rescue's seed map: converged lanes map to
+    themselves, failed lanes map to the nearest CONVERGED lane in
+    z-scored condition space (never to another failed lane)."""
+    from pycatkin_tpu.parallel.batch import _neighbor_seed_lanes
+
+    grid = [(-2.4, -2.4), (-2.3, -2.4), (-1.0, -1.0), (0.4, 0.4)]
+    conds = _volcano_conditions(volcano, grid)
+    success = np.array([True, False, True, False])
+    nn = _neighbor_seed_lanes(conds, success)
+    assert nn[0] == 0 and nn[2] == 2          # converged: identity
+    assert success[nn[1]] and success[nn[3]]  # failed -> converged
+    # lane 1 (-2.3,-2.4) is far closer to lane 0 (-2.4,-2.4) than to
+    # lane 2 (-1,-1); the z-scored metric must respect that.
+    assert nn[1] == 0
+
+    # degenerate cases: nothing converged / nothing failed -> None
+    assert _neighbor_seed_lanes(conds, np.zeros(4, dtype=bool)) is None
+    assert _neighbor_seed_lanes(conds, np.ones(4, dtype=bool)) is None
+
+
+def test_chunked_nearest_matches_brute_force():
+    """The scipy-free nearest-neighbor fallback must agree with the
+    brute-force answer (it backs _neighbor_seed_lanes on minimal
+    installs), including across chunk boundaries."""
+    from pycatkin_tpu.parallel.batch import _chunked_nearest
+
+    rng = np.random.default_rng(3)
+    Xf = rng.normal(size=(300, 5))          # > 2 chunks of 128
+    Xo = rng.normal(size=(997, 5))
+    brute = np.argmin(((Xf[:, None, :] - Xo[None, :, :]) ** 2).sum(-1),
+                      axis=1)
+    np.testing.assert_array_equal(_chunked_nearest(Xf, Xo), brute)
