@@ -142,9 +142,15 @@ def main():
     from pycatkin_tpu.parallel.batch import prewarm_sweep_programs
     from pycatkin_tpu.utils.retry import call_with_backend_retry
     t0 = time.perf_counter()
+    # 512 rides in the EXECUTED buckets: the timed trials' failed
+    # subset lands there (measured 269 fail at trial T-shifts vs 246
+    # at the warmup shift -> bucket 256), and an AOT-only program
+    # still pays a ~4-7 s first-execution load -- which showed up as a
+    # systematically slow FIRST timed trial in every round-5 run until
+    # this was executed during prewarm instead.
     n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
-                                    buckets=(64, 128, 256),
-                                    aot_buckets=(512, 1024),
+                                    buckets=(64, 128, 256, 512),
+                                    aot_buckets=(1024,),
                                     check_stability=True, verbose=True)
     prewarm_s = time.perf_counter() - t0
     log(f"prewarm ({n_prog} programs, incl. any compiles): "

@@ -14,8 +14,8 @@ Programs warmed (via parallel.batch.prewarm_sweep_programs, the same
 routine bench.py runs before its timed region, with bench's exact
 bucket configuration): the fast-pass sweep program at the full
 [grid_n^2] lane shape, the PTC/LM rescue programs (seeded and
-unseeded) at the 64/128/256-lane pow2 buckets (executed) plus the
-512/1024 insurance buckets (AOT-compiled only), the stability screen +
+unseeded) at the 64/128/256/512-lane pow2 buckets (executed) plus the
+1024 insurance bucket (AOT-compiled only), the stability screen +
 tier-2 subset Jacobian, and the TOF/activity program -- the complete
 sweep_steady_state surface for the flagship workload.
 """
@@ -57,8 +57,8 @@ def main():
     # EXACTLY bench.py's prewarm configuration: an image warmed here
     # must leave bench's prewarm nothing to compile.
     n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
-                                    buckets=(64, 128, 256),
-                                    aot_buckets=(512, 1024),
+                                    buckets=(64, 128, 256, 512),
+                                    aot_buckets=(1024,),
                                     check_stability=True, verbose=True)
     print(f"warmed {n_prog} programs in {time.perf_counter() - t0:.1f} s; "
           f"a fresh process now loads all {grid_n * grid_n}-lane volcano "
