@@ -245,16 +245,32 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
     one call returns (certified [lanes], ambiguous [lanes],
     n_ambiguous scalar).
 
-    The certificate is SOUND one-way: bound <= tol proves stability;
-    bound > tol proves nothing (Gershgorin is not tight). Microkinetic
-    dynamic-block Jacobians are near-compartmental (off-diagonal
-    production terms nonnegative, in-group columns summing to ~zero),
-    so the COLUMN bound typically sits at ~0 and certifies the vast
-    majority of converged lanes on-device; only the ambiguous rest pays
-    a host nonsymmetric-eig solve (XLA has none on TPU)."""
-    from ..solvers.newton import stability_tolerance_from_scale
+    Both certificates are SOUND one-way: passing proves stability;
+    failing proves nothing. Two device tiers run in the same program:
+
+    - Gershgorin (row AND column discs): free, but hopeless for stiff
+      kinetics Jacobians -- the conservation-null eigenvalue sits at
+      ~0 inside a disc of radius ~||J||; measured on the 256x256 COOx
+      volcano it clears ~0.1 % of lanes.
+    - Deflated Lyapunov witness
+      (:func:`solvers.newton.lyapunov_certified_stable`): deflates the
+      exact conservation nullspace, then constructs and CHECKS a
+      Lyapunov certificate per lane (an m^2 x m^2 solve, m = deflated
+      dimension -- 3 for the volcano). Clears ~87 % of volcano lanes;
+      skipped when m > LYAPUNOV_MAX_DIM.
+
+    Only the remaining ambiguous lanes pay a host nonsymmetric-eig
+    solve (XLA has none on TPU)."""
+    from ..solvers.newton import (LYAPUNOV_MAX_DIM,
+                                  deflation_basis_for_spec,
+                                  lyapunov_certified_stable,
+                                  stability_tolerance_from_scale)
 
     dyn = jnp.asarray(spec.dynamic_indices)
+    Q = deflation_basis_for_spec(spec)       # static per spec
+    # m == 0 (all-conservation spectrum) has nothing to certify and
+    # would crash the kernel's empty reductions at trace time.
+    use_lyap = 0 < Q.shape[1] <= LYAPUNOV_MAX_DIM
 
     def screen_one(cond, y):
         J = engine.steady_jacobian(spec, cond, y[dyn])
@@ -265,13 +281,16 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
         bound = jnp.minimum(jnp.max(diag + offrow), jnp.max(diag + offcol))
         scale = jnp.max(absJ)
         finite = jnp.all(jnp.isfinite(J))
-        return bound, scale, finite
+        tol = stability_tolerance_from_scale(scale, pos_tol)
+        cert = finite & (bound <= tol)
+        if use_lyap:
+            cert = cert | (finite & lyapunov_certified_stable(J, Q, tol))
+        return cert, finite
 
     def batched(conds, ys, ok):
-        bound, scale, finite = jax.vmap(screen_one)(conds, ys)
-        tol = stability_tolerance_from_scale(scale, pos_tol)
+        cert, finite = jax.vmap(screen_one)(conds, ys)
         good = finite & ok
-        certified = good & (bound <= tol)
+        certified = good & cert
         ambiguous = good & ~certified
         return certified, ambiguous, jnp.sum(ambiguous)
 
@@ -301,22 +320,21 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     """[lanes] Jacobian-eigenvalue stability verdict (reference
     solver.py:102-106) for batched steady solutions, two-tier:
 
-    1. On-device Gershgorin certificate: lanes whose certified bound on
-       max Re(lambda) clears the scale-aware threshold are stable, full
-       stop. The certificate, threshold AND combination stay on device;
-       the only mandatory host traffic is ONE scalar (the ambiguous
-       count) -- on the tunneled backend every device->host
-       materialization call costs ~0.8-1.2 s of round trip regardless
-       of size (measured round 4), so per-lane arrays cross only when
-       tier 2 actually runs.
+    1. On-device certificates (one program): Gershgorin discs (cheap,
+       but nearly useless for stiff kinetics -- measured ~0.1 % of
+       volcano lanes) plus the deflated-Lyapunov witness
+       (:func:`solvers.newton.lyapunov_certified_stable`, ~85-87 % of
+       volcano lanes). Certified lanes are stable, full stop; the only
+       mandatory host traffic is ONE scalar (the ambiguous count).
     2. Host ``numpy.linalg.eigvals`` on the AMBIGUOUS subset only (the
-       certificate is one-sided; XLA ships no nonsymmetric eig on TPU).
+       certificates are one-sided; XLA ships no nonsymmetric eig on
+       TPU).
 
     Both tiers use the :func:`solvers.newton.stability_tolerance_from_scale`
     formula, so the verdict matches the all-host implementation exactly
-    on lanes where the certificate abstains, and can only differ by
-    declaring a lane stable that the host eig ALSO declares stable (the
-    bound majorizes max Re(lambda)).
+    on lanes where the certificates abstain, and can only differ by
+    declaring a lane stable that the host eig ALSO declares stable
+    (both certificates are sound one-way proofs).
 
     ``ok``: optional [lanes] convergence mask -- non-converged or
     non-finite lanes are reported unstable without entering the
@@ -711,6 +729,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            opts: SolverOptions = SolverOptions(),
                            buckets=(64, 128, 256),
                            aot_buckets=(),
+                           tier2_buckets=(),
                            check_stability: bool = True,
                            pos_jac_tol: float = 1e-2,
                            verbose: bool = False):
@@ -735,6 +754,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     and a later in-band hit pays only the trace + persistent-cache
     executable load, never the full compile. Put the likely failure
     scales in ``buckets`` and the insurance scales in ``aot_buckets``.
+    ``tier2_buckets`` warm (execute) ONLY the subset-Jacobian program
+    at additional shapes -- the stability tier-2's ambiguous subset is
+    typically far larger than the rescue's failed subset (the
+    Lyapunov certificate abstains on ~13-15 % of volcano lanes ->
+    pow2 buckets of 8192/16384), so its bucket universe is separate.
     A sweep whose failed subset pads beyond the largest bucket still
     compiles in-band. Returns the number of programs touched; each
     call (including its own materialization) rides the transient-error
@@ -788,6 +812,23 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         timed_retry(run_tof, f"tof/activity @{n}")
         n_prog += 1
     dyn = jnp.asarray(spec.dynamic_indices)
+
+    def warm_jac(b):
+        """Execute the subset-Jacobian (tier-2) program at bucket b --
+        shared by the rescue-bucket loop and tier2_buckets."""
+        idx = np.arange(b) % n
+        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
+                                     conds)
+        ysub = jnp.asarray(ys)[idx]
+        jprog = _jacobian_program(spec)
+
+        def run():
+            J = jprog(sub, ysub)
+            np.asarray(jnp.sum(jnp.where(jnp.isfinite(J), J, 0.0)))
+            return J
+
+        timed_retry(run, f"tier-2 jac @{b}")
+
     for b in buckets:
         idx = np.arange(b) % n
         sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
@@ -820,15 +861,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                     f"rescue[ptc,unseeded] @{b}")
         n_prog += 1
         if check_stability:
-            jprog = _jacobian_program(spec)
-            ysub = jnp.asarray(ys)[idx]
-
-            def run_jac():
-                J = jprog(sub, ysub)
-                np.asarray(jnp.sum(jnp.where(jnp.isfinite(J), J, 0.0)))
-                return J
-
-            timed_retry(run_jac, f"tier-2 jac @{b}")
+            warm_jac(b)
+            n_prog += 1
+    if check_stability:
+        for b in tier2_buckets:
+            warm_jac(b)
             n_prog += 1
     for b in aot_buckets:
         idx = np.arange(b) % n

@@ -459,6 +459,109 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     return x_out, success, f_out, iters, attempts
 
 
+def deflation_basis(groups_dyn) -> "np.ndarray":
+    """Orthonormal basis Q [n_dyn, m] of the complement of the
+    conservation rows -- the deflated subspace the Lyapunov stability
+    certificate works in.
+
+    The group indicators g are LEFT null vectors of every steady
+    Jacobian (the dynamics conserve g.y exactly, so g.J = 0): range(J)
+    lies in g-perp, g-perp is J-invariant, and the quotient block is
+    exactly zero. The spectrum therefore splits EXACTLY as
+    eig(J) = eig(Q.T J Q) + {0 per independent group}, and the
+    conservation-null eigenvalues (always <= the positive stability
+    tolerance) can be deflated away before certifying. Host-side numpy
+    (static per spec; the result enters jitted programs as a
+    constant)."""
+    import numpy as np
+    G = np.asarray(groups_dyn, dtype=np.float64)
+    G = G[(G > 0).any(axis=1)] if G.size else G.reshape(0, G.shape[-1])
+    n = np.asarray(groups_dyn).shape[-1]
+    if G.shape[0] == 0:
+        return np.eye(n)
+    _, s, Vt = np.linalg.svd(G)
+    rank = int((s > 1e-12 * max(s[0], 1.0)).sum())
+    return np.ascontiguousarray(Vt[rank:].T)
+
+
+def deflation_basis_for_spec(spec) -> "np.ndarray":
+    """:func:`deflation_basis` for a ModelSpec's dynamic block -- the
+    ONE recipe (group rows restricted to the dynamic indices) shared by
+    the production stability screen and the certificate tests, so the
+    tests always validate the exact Q the screen uses."""
+    import numpy as np
+    groups_dyn = np.asarray(spec.groups)[:, np.asarray(
+        spec.dynamic_indices)]
+    return deflation_basis(groups_dyn)
+
+
+# Deflated dimension above which the batched Lyapunov certificate is
+# skipped (its kron system is m^2 x m^2 per lane; beyond this the
+# Gershgorin tier + host eig fallback carry the verdict alone).
+LYAPUNOV_MAX_DIM = 8
+
+
+def lyapunov_certified_stable(J, Q, tol):
+    """Device-side SOUND one-way stability certificate via a deflated
+    Lyapunov solve (jittable / vmappable; small m only).
+
+    Gershgorin discs are hopeless for stiff kinetics Jacobians (the
+    conservation-null eigenvalue sits at ~0 with disc radius ~||J||;
+    measured on the COOx volcano the plain certificate clears 0.3 % of
+    lanes). This tier instead works in the conservation-deflated
+    subspace (:func:`deflation_basis` -- the deflation is exact) and
+    certifies ``max Re eig(J) <= tol`` by explicitly constructing a
+    Lyapunov witness for ``A = (Q^T J Q - tol I)/scale``:
+
+        solve  (I (x) A^T + A^T (x) I) vec(P) = -vec(I)
+        S = sym(P),  R = A^T S + S A + I
+
+    If S is positive definite (elimination pivots with a rounding
+    margin) and ||R||_2 < 1 (symmetric Gershgorin row-sum bound plus a
+    floating-point margin), then A^T S + S A = R - I is negative
+    definite with S > 0 -- a complete Lyapunov stability proof for A,
+    hence Re eig(J) < tol. Every check runs on the COMPUTED matrices,
+    so a bad solve (ill-conditioned kron system near marginal
+    stability) can only ABSTAIN, never falsely certify; lanes that
+    abstain fall through to the host eigensolve exactly as before.
+    Verified against dense eig on 40k adversarial random matrices
+    (including +-1e-8-relative marginal bands): zero unsound
+    certifications (40k sweep during round-5 development; 800
+    re-checked on every test run, tests/test_verdicts.py).
+
+    J: [n, n]; Q: [n, m] static with m >= 1 (callers gate m == 0 --
+    an all-conservation spectrum -- to the other tiers); tol: scalar.
+    Returns a bool scalar.
+    """
+    m = Q.shape[1]
+    Qc = jnp.asarray(Q, dtype=J.dtype)
+    B = Qc.T @ J @ Qc
+    eye = jnp.eye(m, dtype=J.dtype)
+    sc = jnp.maximum(jnp.max(jnp.abs(B)), 1e-300)
+    A = (B - tol * eye) / sc
+    K = (jnp.kron(eye, A.T) + jnp.kron(A.T, eye))
+    p = linalg.solve(K, -eye.reshape(-1))
+    S = 0.5 * (p.reshape(m, m) + p.reshape(m, m).T)
+    R = A.T @ S + S @ A + eye
+    R = 0.5 * (R + R.T)
+    pmax = jnp.max(jnp.abs(S))
+    eps = jnp.finfo(J.dtype).eps
+    bound_R = (jnp.max(jnp.sum(jnp.abs(R), axis=1))
+               + 64.0 * eps * m * m * jnp.maximum(pmax, 1.0))
+    ok = jnp.all(jnp.isfinite(S)) & (bound_R < 0.5)
+    # PD of S: unrolled elimination pivots with a rounding margin.
+    pd_margin = 64.0 * eps * m * pmax
+    M = S
+    idx = jnp.arange(m)
+    for k in range(m):
+        piv = M[k, k]
+        ok = ok & (piv > pd_margin)
+        denom = jnp.where(piv > pd_margin, piv, 1.0)
+        M = M - jnp.where((idx > k)[:, None],
+                          jnp.outer(M[:, k], M[k, :] / denom), 0.0)
+    return ok
+
+
 def stability_tolerance_from_scale(scale, pos_tol: float = 1e-2,
                                    eps: float | None = None):
     """Scale-aware stability threshold from a precomputed max|J|.

@@ -460,3 +460,108 @@ def test_chord_steps_same_root():
         # rounding -- so bound multiplicatively with generous slack
         # rather than pinning the trajectory.
         assert int(r2.iterations) <= 2 * int(r0.iterations)
+
+
+def test_lyapunov_certificate_sound_on_adversarial_matrices():
+    """The deflated-Lyapunov stability certificate must NEVER certify a
+    matrix whose max Re(eig) exceeds the tolerance -- including
+    marginal bands within +-1e-8 relative of the threshold -- and
+    should certify a decent fraction of genuinely stable ones (it is
+    one-way: abstaining is always allowed, lying is not)."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu.solvers.newton import lyapunov_certified_stable
+
+    rng = np.random.default_rng(11)
+    n_unstable = n_unsound = n_stable = n_certified = 0
+    for trial in range(800):
+        m = int(rng.integers(2, 6))
+        A = rng.normal(size=(m, m)) * 10.0 ** rng.integers(-3, 12)
+        emax = np.real(np.linalg.eigvals(A)).max()
+        tol = 1e-2 + 64 * np.finfo(float).eps * np.abs(A).max()
+        kind = trial % 4
+        if kind == 1:    # marginally unstable
+            A = A + np.eye(m) * (tol * (1 + 10.0 ** rng.uniform(-8, 0))
+                                 - emax)
+        elif kind == 2:  # marginally stable
+            A = A + np.eye(m) * (tol * (1 - 10.0 ** rng.uniform(-8, 0))
+                                 - emax)
+        emax = np.real(np.linalg.eigvals(A)).max()
+        cert = bool(lyapunov_certified_stable(jnp.asarray(A),
+                                              np.eye(m), tol))
+        if emax > tol:
+            n_unstable += 1
+            n_unsound += cert
+        else:
+            n_stable += 1
+            n_certified += cert
+    assert n_unsound == 0, f"{n_unsound}/{n_unstable} unsound"
+    assert n_certified > 0.5 * n_stable     # it must actually certify
+
+
+def test_lyapunov_certificate_on_volcano_lanes(ref_root):
+    """On real COOx volcano Jacobians the certificate must agree
+    one-way with the host eigensolve (certified -> stable) and clear
+    the majority of lanes (the whole point of the tier: Gershgorin
+    clears ~0)."""
+    import jax
+    import jax.numpy as jnp
+
+    import pycatkin_tpu as pk
+    from pycatkin_tpu.models import coox
+    from pycatkin_tpu.parallel import batch
+    from pycatkin_tpu.solvers.newton import (SolverOptions,
+                                             deflation_basis_for_spec,
+                                             lyapunov_certified_stable,
+                                             stability_tolerance_from_scale)
+    from tests.conftest import reference_path
+
+    sim = pk.read_from_input_file(
+        reference_path("examples", "COOxVolcano", "input.json"))
+    spec = sim.spec
+    be = np.linspace(-2.5, 0.5, 8)
+    conds, _ = coox.volcano_grid_conditions(sim, be)
+    res = batch.batch_steady_state(
+        spec, conds, opts=batch._fast_pass_opts(SolverOptions()))
+    Js = np.asarray(batch._jacobian_program(spec)(conds,
+                                                  jnp.asarray(res.x)))
+    # The SAME Q recipe the production screen uses (shared helper).
+    Q = deflation_basis_for_spec(spec)
+    # Deflation exactness: eig(J) = eig(Q^T J Q) + {0 per group}.
+    B = Q.T @ Js[10] @ Q
+    eJ = np.sort(np.linalg.eigvals(Js[10]).real)
+    eB = np.sort(np.concatenate([np.linalg.eigvals(B).real, [0.0]]))
+    np.testing.assert_allclose(eJ, eB, rtol=1e-6,
+                               atol=1e-6 * np.abs(Js[10]).max())
+
+    tol = np.asarray(stability_tolerance_from_scale(
+        np.abs(Js).max(axis=(1, 2))))
+    cert = np.asarray(jax.vmap(
+        lambda J, t: lyapunov_certified_stable(J, Q, t))(
+            jnp.asarray(Js), jnp.asarray(tol)))
+    stable = np.linalg.eigvals(Js).real.max(axis=1) <= tol
+    assert not np.any(cert & ~stable), "certified an unstable lane"
+    assert cert.sum() >= 0.6 * len(Js)      # clears the majority
+
+
+def test_lyapunov_certificate_rejects_bistable_unstable_root(bistable):
+    """The middle (unstable) root of the bistable mechanism must NOT be
+    certified stable by the Lyapunov tier."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.solvers.newton import (deflation_basis_for_spec,
+                                             lyapunov_certified_stable,
+                                             stability_tolerance_from_scale)
+
+    spec, cond = bistable.spec, bistable.conditions()
+    for a, expect_stable in ((A_UNSTABLE, False), (A_STABLE, True)):
+        y = _full_y(bistable, a)
+        J = np.asarray(engine.steady_jacobian(
+            spec, cond, jnp.asarray(y)[jnp.asarray(
+                spec.dynamic_indices)]))
+        Q = deflation_basis_for_spec(spec)
+        tol = float(stability_tolerance_from_scale(np.abs(J).max()))
+        cert = bool(lyapunov_certified_stable(jnp.asarray(J), Q, tol))
+        if not expect_stable:
+            assert not cert        # soundness: never certify unstable
