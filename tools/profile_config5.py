@@ -9,6 +9,13 @@ measured end-to-end wall time. Run on the benchmark device:
     python tools/profile_config5.py
 
 Results of a run are committed in docs/perf_config5.md.
+
+CAVEAT (round-5): this script fences with ``jax.block_until_ready``,
+which does NOT synchronize on the tunneled axon backend -- its
+RELATIVE component comparisons on a co-located host remain valid (and
+its committed conclusions were re-derived through honest fences in
+docs/perf_config5.md §9-10), but for absolute walls on the tunneled
+device use ``pycatkin_tpu.utils.profiling.run_timed`` instead.
 """
 
 import os
