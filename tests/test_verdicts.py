@@ -465,7 +465,7 @@ def test_chord_steps_same_root():
 def test_lyapunov_certificate_sound_on_adversarial_matrices():
     """The deflated-Lyapunov stability certificate must NEVER certify a
     matrix whose max Re(eig) exceeds the tolerance -- including
-    marginal bands within +-1e-8 relative of the threshold -- and
+    marginal bands within +-1e-10 relative of the threshold -- and
     should certify a decent fraction of genuinely stable ones (it is
     one-way: abstaining is always allowed, lying is not)."""
     import jax.numpy as jnp
@@ -481,10 +481,10 @@ def test_lyapunov_certificate_sound_on_adversarial_matrices():
         tol = 1e-2 + 64 * np.finfo(float).eps * np.abs(A).max()
         kind = trial % 4
         if kind == 1:    # marginally unstable
-            A = A + np.eye(m) * (tol * (1 + 10.0 ** rng.uniform(-8, 0))
+            A = A + np.eye(m) * (tol * (1 + 10.0 ** rng.uniform(-10, 0))
                                  - emax)
         elif kind == 2:  # marginally stable
-            A = A + np.eye(m) * (tol * (1 - 10.0 ** rng.uniform(-8, 0))
+            A = A + np.eye(m) * (tol * (1 - 10.0 ** rng.uniform(-10, 0))
                                  - emax)
         emax = np.real(np.linalg.eigvals(A)).max()
         cert = bool(lyapunov_certified_stable(jnp.asarray(A),
@@ -541,7 +541,9 @@ def test_lyapunov_certificate_on_volcano_lanes(ref_root):
             jnp.asarray(Js), jnp.asarray(tol)))
     stable = np.linalg.eigvals(Js).real.max(axis=1) <= tol
     assert not np.any(cert & ~stable), "certified an unstable lane"
-    assert cert.sum() >= 0.6 * len(Js)      # clears the majority
+    # With the Higham-margin residual bound the certificate clears
+    # ~99 % of volcano lanes (measured 1018/1024 on the 32x32 grid).
+    assert cert.sum() >= 0.9 * len(Js)
 
 
 def test_lyapunov_certificate_rejects_bistable_unstable_root(bistable):
