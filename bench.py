@@ -151,8 +151,8 @@ def main():
     n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
                                     buckets=(64, 128, 256, 512),
                                     aot_buckets=(1024,),
-                                    tier2_buckets=(1024, 2048, 4096),
-                                    tier2_aot_buckets=(8192, 16384),
+                                    tier2_buckets=(8192, 16384),
+                                    tier2_aot_buckets=(2048, 4096),
                                     check_stability=True, verbose=True)
     prewarm_s = time.perf_counter() - t0
     log(f"prewarm ({n_prog} programs, incl. any compiles): "

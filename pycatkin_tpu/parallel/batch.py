@@ -759,12 +759,13 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     ``tier2_buckets`` warm (execute) ONLY the subset-Jacobian program
     at additional shapes -- the stability tier-2's ambiguous subset
     follows a different count distribution than the rescue's failed
-    subset (the Lyapunov certificate abstains on <~1 % of volcano
-    lanes -> pow2 buckets around 512-4096), so its bucket universe is
-    separate; ``tier2_aot_buckets`` AOT-compile the Jacobian program
-    at insurance shapes beyond that (e.g. 8192/16384, reached only if
-    the certificate's abstention rate regresses -- near-free to warm,
-    ruinous to compile in-band).
+    subset, and it is BACKEND-dependent: the Lyapunov certificate's
+    error margin tracks the backend's unit roundoff, so it abstains on
+    <~1 % of volcano lanes on true-f64 CPU but ~14 % on the emulated-
+    f64 TPU (measured: warmup and trial ambiguous counts both ~9.5k ->
+    bucket 16384). Put the production backend's likely shapes here and
+    other scales in ``tier2_aot_buckets`` (AOT compile only --
+    near-free to warm, ruinous to compile in-band).
     A sweep whose failed subset pads beyond the largest bucket still
     compiles in-band. Returns the number of programs touched; each
     call (including its own materialization) rides the transient-error
@@ -819,13 +820,16 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         n_prog += 1
     dyn = jnp.asarray(spec.dynamic_indices)
 
-    def warm_jac(b):
-        """Execute the subset-Jacobian (tier-2) program at bucket b --
-        shared by the rescue-bucket loop and tier2_buckets."""
+    def _jac_args(b):
         idx = np.arange(b) % n
         sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
                                      conds)
-        ysub = jnp.asarray(ys)[idx]
+        return sub, jnp.asarray(ys)[idx]
+
+    def warm_jac(b):
+        """Execute the subset-Jacobian (tier-2) program at bucket b --
+        shared by the rescue-bucket loop and tier2_buckets."""
+        sub, ysub = _jac_args(b)
         jprog = _jacobian_program(spec)
 
         def run():
@@ -834,6 +838,14 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             return J
 
         timed_retry(run, f"tier-2 jac @{b}")
+
+    def aot_jac(b):
+        """AOT-compile (no execution) the subset-Jacobian at bucket b
+        -- the ONE recipe for all insurance-shape warming."""
+        sub, ysub = _jac_args(b)
+        jprog = _jacobian_program(spec)
+        timed_retry(lambda: jprog.lower(sub, ysub).compile(),
+                    f"aot tier-2 jac @{b}")
 
     for b in buckets:
         idx = np.arange(b) % n
@@ -874,13 +886,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             warm_jac(b)
             n_prog += 1
         for b in tier2_aot_buckets:
-            idx = np.arange(b) % n
-            sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
-                                         conds)
-            ysub = jnp.asarray(ys)[idx]
-            jprog = _jacobian_program(spec)
-            timed_retry(lambda p=jprog: p.lower(sub, ysub).compile(),
-                        f"aot tier-2 jac @{b}")
+            aot_jac(b)
             n_prog += 1
     for b in aot_buckets:
         idx = np.arange(b) % n
@@ -898,10 +904,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                 f"aot rescue[{strat}{'' if seed_x0 is not None else ',unseeded'}] @{b}")
             n_prog += 1
         if check_stability:
-            jprog = _jacobian_program(spec)
-            ysub = jnp.asarray(ys)[idx]
-            timed_retry(lambda: jprog.lower(sub, ysub).compile(),
-                        f"aot tier-2 jac @{b}")
+            aot_jac(b)
             n_prog += 1
     return n_prog
 

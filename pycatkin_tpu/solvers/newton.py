@@ -563,9 +563,11 @@ def lyapunov_certified_stable(J, Q, tol):
     # 64x factor was measured to cost ~14 % of volcano-lane
     # certifications whose CPU-arithmetic residuals are provably
     # fine).
-    import jax as _jax
-    emulated = _jax.default_backend() != "cpu"
-    eps = (16.0 if emulated else 1.0) * jnp.finfo(J.dtype).eps
+    # Sound-first default: only backends KNOWN to have native IEEE f64
+    # (CPU, CUDA/ROCm GPUs) get the tight 1x margin; anything else --
+    # TPU, axon, future accelerators -- is assumed emulated (16x).
+    native_f64 = jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+    eps = (1.0 if native_f64 else 16.0) * jnp.finfo(J.dtype).eps
     absA, absS = jnp.abs(A), jnp.abs(S)
     E = 4.0 * (m + 2) * eps * (absA.T @ absS + absS @ absA + eye)
     E = 0.5 * (E + E.T)

@@ -59,8 +59,8 @@ def main():
     n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
                                     buckets=(64, 128, 256, 512),
                                     aot_buckets=(1024,),
-                                    tier2_buckets=(1024, 2048, 4096),
-                                    tier2_aot_buckets=(8192, 16384),
+                                    tier2_buckets=(8192, 16384),
+                                    tier2_aot_buckets=(2048, 4096),
                                     check_stability=True, verbose=True)
     print(f"warmed {n_prog} programs in {time.perf_counter() - t0:.1f} s; "
           f"a fresh process now loads all {grid_n * grid_n}-lane volcano "
