@@ -1,0 +1,18 @@
+# Test lanes. Tier-1 (the default gate) runs the fast suite on the CPU
+# backend; the faults lane isolates the fault-injection / degradation /
+# journal-resume tests (they are also part of tier-1 -- pytest marker
+# `faults` stays inside the default `not slow` selection).
+
+PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	--continue-on-collection-errors -p no:cacheprovider
+
+.PHONY: test test-faults test-all
+
+test:
+	$(PYTEST) -m 'not slow'
+
+test-faults:
+	$(PYTEST) -m faults
+
+test-all:
+	$(PYTEST) -m ''

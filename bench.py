@@ -10,6 +10,13 @@ full grid.
 Prints exactly one JSON line:
   {"metric": ..., "value": pts/s, "unit": "points/s", "vs_baseline": x}
 plus human-readable detail on stderr.
+
+Durable mode (docs/failure_model.md): ``--journal DIR [--chunk N]``
+runs the same grid through the chunked, journaled, degradation-tolerant
+runner (pycatkin_tpu.robustness); a killed run restarted with
+``--journal DIR --resume`` re-dispatches only unfinished chunks. This
+mode also prints exactly one JSON line (a durability report, not a
+timing record -- chunked dispatch is not the throughput path).
 """
 
 import json
@@ -81,20 +88,11 @@ def scipy_baseline_seconds_per_point(sim, sample_points):
     return total / len(sample_points)
 
 
-def main():
-    from pycatkin_tpu.utils.cache import enable_persistent_cache
-    cache_dir = enable_persistent_cache()
-
-    import jax
-
+def _build_problem():
+    """(sim, spec, conds, mask, metric, have_ref) for the north-star
+    grid: the reference COOx volcano when its input tree exists, else
+    the self-contained synthetic fallback."""
     from pycatkin_tpu import engine
-    from pycatkin_tpu.parallel.batch import sweep_steady_state
-
-    log(f"persistent compilation cache: "
-        f"{cache_dir if cache_dir else 'disabled (cpu backend)'}")
-
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} ({dev.device_kind})")
 
     try:
         import pycatkin_tpu as pk
@@ -121,6 +119,24 @@ def main():
         conds = conds._replace(T=np.linspace(400.0, 800.0, n))
         mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
         metric = f"synthetic {GRID_N}x{GRID_N} steady-state grid"
+    return sim, spec, conds, mask, metric, have_ref
+
+
+def main():
+    from pycatkin_tpu.utils.cache import enable_persistent_cache
+    cache_dir = enable_persistent_cache()
+
+    import jax
+
+    from pycatkin_tpu.parallel.batch import sweep_steady_state
+
+    log(f"persistent compilation cache: "
+        f"{cache_dir if cache_dir else 'disabled (cpu backend)'}")
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    sim, spec, conds, mask, metric, have_ref = _build_problem()
 
     n_points = GRID_N * GRID_N
 
@@ -298,6 +314,63 @@ def main():
     print(json.dumps(result))
 
 
+def journal_main(argv):
+    """Durable chunked sweep with checkpoint/resume (--journal mode).
+
+    Prints exactly one JSON line: a durability report (chunks run/
+    reused/degraded/salvaged, failed lanes, wall), not a throughput
+    record.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py", description="journaled chunked volcano sweep")
+    ap.add_argument("--journal", required=True,
+                    help="journal directory (created if missing)")
+    ap.add_argument("--resume", action="store_true",
+                    help="replay the journal, re-dispatching only "
+                         "unfinished chunks")
+    ap.add_argument("--chunk", type=int, default=4096,
+                    help="lanes per chunk (default 4096)")
+    args = ap.parse_args(argv)
+
+    from pycatkin_tpu.utils.cache import enable_persistent_cache
+    enable_persistent_cache()
+
+    import jax
+
+    from pycatkin_tpu.robustness import chunked_sweep_steady_state
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    sim, spec, conds, mask, metric, _ = _build_problem()
+
+    t0 = time.perf_counter()
+    out, report = chunked_sweep_steady_state(
+        spec, conds, chunk=args.chunk, tof_mask=mask,
+        opts=sim.solver_options(), check_stability=True,
+        journal=args.journal, resume=args.resume, verbose=True)
+    wall = time.perf_counter() - t0
+
+    n = int(np.asarray(out["success"]).shape[0])
+    result = {
+        "metric": metric + " (journaled chunked mode)",
+        "journal": args.journal,
+        "resumed": bool(args.resume),
+        "chunk": report["chunk"],
+        "n_chunks": report["n_chunks"],
+        "reused_chunks": report["reused"],
+        "degraded_chunks": report["degraded"],
+        "salvaged_chunks": report["salvaged"],
+        "n_failed_lanes": report["n_failed_lanes"],
+        "converged": int(np.sum(np.asarray(out["success"]))),
+        "n_points": n,
+        "wall_s": round(wall, 2),
+    }
+    print(json.dumps(result))
+
+
 def _prior_round_value():
     """Throughput recorded by the most recent checked-in BENCH_r*.json
     (the driver writes one per round), or None."""
@@ -323,4 +396,9 @@ def _prior_round_value():
 
 
 if __name__ == "__main__":
-    main()
+    # No arguments: the historical timing benchmark, exactly one JSON
+    # line. Any argument switches to the journaled chunked mode.
+    if len(sys.argv) > 1:
+        journal_main(sys.argv[1:])
+    else:
+        main()

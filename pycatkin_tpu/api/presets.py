@@ -27,6 +27,7 @@ import pandas as pd
 from .. import engine
 from ..parallel.batch import (batch_steady_state, batch_transient,
                               stack_conditions)
+from ..robustness.ladder import run_chunk_with_ladder
 from ..solvers.ode import log_time_grid
 
 
@@ -103,14 +104,37 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
 
     if steady_state_solve:
         x0 = ys[:, -1, :][:, spec.dynamic_indices]
-        res = batch_steady_state(spec, batched, x0=x0,
-                                 opts=sim_system.solver_options())
-        finals = np.asarray(res.x)
-        if not bool(np.all(np.asarray(res.success))):
-            bad = [values[i]
-                   for i in np.flatnonzero(~np.asarray(res.success))]
-            print(f"Warning: steady solve unconverged for sweep values "
-                  f"{bad}", file=sys.stderr)
+        sopts = sim_system.solver_options()
+
+        def run_steady(device=None):
+            import contextlib
+            ctx = (jax.default_device(device) if device is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                return batch_steady_state(spec, batched, x0=x0, opts=sopts)
+
+        def reject_poisoned(res):
+            bad = np.asarray(res.success) & ~np.all(
+                np.isfinite(np.asarray(res.x)), axis=-1)
+            return (f"{int(bad.sum())} converged lane(s) with non-finite "
+                    "state" if bad.any() else None)
+
+        # Degradation ladder (robustness/ladder.py): a steady solve
+        # that dies on every rung degrades to the transient finals with
+        # a structured event + warning instead of killing the sweep.
+        res, _ = run_chunk_with_ladder(run_steady, label="preset:steady",
+                                       validate=reject_poisoned)
+        if res is None:
+            print("Warning: batched steady solve failed on every "
+                  "degradation rung; falling back to transient finals "
+                  "(see diagnostics events)", file=sys.stderr)
+        else:
+            finals = np.asarray(res.x)
+            if not bool(np.all(np.asarray(res.success))):
+                bad = [values[i]
+                       for i in np.flatnonzero(~np.asarray(res.success))]
+                print(f"Warning: steady solve unconverged for sweep "
+                      f"values {bad}", file=sys.stderr)
 
     rates = np.asarray(_net_rates_program(spec)(batched,
                                                 jnp.asarray(finals)))

@@ -231,8 +231,21 @@ def _jacobian_program(spec: ModelSpec):
     return jax.jit(jax.vmap(jac_one))
 
 
+def _resolve_backend(backend=None, mesh: Optional[Mesh] = None) -> str:
+    """Concrete backend/platform string for certificate-margin
+    selection: an explicit ``backend`` wins, else the mesh's devices'
+    platform, else ``jax.default_backend()`` read NOW (call time --
+    never baked into a cached program at trace time, ADVICE r5)."""
+    if backend is not None:
+        return str(backend)
+    if mesh is not None:
+        return mesh.devices.flat[0].platform
+    return jax.default_backend()
+
+
 @lru_cache(maxsize=16)
-def _stability_screen_program(spec: ModelSpec, pos_tol: float):
+def _stability_screen_program(spec: ModelSpec, pos_tol: float,
+                              backend: str = "cpu"):
     """Device-side Gershgorin stability certificate + verdict assembly.
 
     For any (real or complex) eigenvalue of J, Re(lambda) is bounded by
@@ -261,12 +274,21 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
       LYAPUNOV_MAX_DIM.
 
     Only the remaining ambiguous lanes pay a host nonsymmetric-eig
-    solve (XLA has none on TPU)."""
+    solve (XLA has none on TPU).
+
+    ``backend`` is part of the cache key: the Lyapunov certificate's
+    error margin tracks the EXECUTING backend's unit roundoff, so the
+    caller that owns the mesh/devices resolves it
+    (:func:`_resolve_backend`) before the cache lookup -- a cached
+    program can never bake in a stale ``jax.default_backend()``
+    choice."""
     from ..solvers.newton import (LYAPUNOV_MAX_DIM,
                                   deflation_basis_for_spec,
+                                  effective_unit_roundoff,
                                   lyapunov_certified_stable,
                                   stability_tolerance_from_scale)
 
+    eps_eff = effective_unit_roundoff(jnp.float64, backend)
     dyn = jnp.asarray(spec.dynamic_indices)
     Q = deflation_basis_for_spec(spec)       # static per spec
     # m == 0 (all-conservation spectrum) has nothing to certify and
@@ -285,7 +307,8 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float):
         tol = stability_tolerance_from_scale(scale, pos_tol)
         cert = finite & (bound <= tol)
         if use_lyap:
-            cert = cert | (finite & lyapunov_certified_stable(J, Q, tol))
+            cert = cert | (finite & lyapunov_certified_stable(
+                J, Q, tol, eps_eff=eps_eff))
         return cert, finite
 
     def batched(conds, ys, ok):
@@ -317,7 +340,8 @@ def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
 
 
 def stability_mask(spec: ModelSpec, conds: Conditions, ys,
-                   pos_tol: float = 1e-2, ok=None) -> jnp.ndarray:
+                   pos_tol: float = 1e-2, ok=None,
+                   backend: Optional[str] = None) -> jnp.ndarray:
     """[lanes] Jacobian-eigenvalue stability verdict (reference
     solver.py:102-106) for batched steady solutions, two-tier:
 
@@ -339,20 +363,24 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
 
     ``ok``: optional [lanes] convergence mask -- non-converged or
     non-finite lanes are reported unstable without entering the
-    eigenvalue solve. Returns a DEVICE bool array.
+    eigenvalue solve. ``backend``: platform of the devices the screen
+    actually runs on (certificate margins are backend-dependent; the
+    caller that owns the mesh passes it -- None reads the default
+    backend at call time). Returns a DEVICE bool array.
     """
     from ..solvers.newton import stability_tolerance
     ys = jnp.asarray(ys)
     n = ys.shape[0]
     ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
               else jnp.ones(n, dtype=bool))
+    backend = _resolve_backend(backend)
     def run_screen():
         # Dispatch AND the scalar materialization inside one retried
         # unit: on the async backend an execution-time transport flake
         # surfaces at the materialization, so retrying only the
         # dispatch would not re-run the program.
         cert, amb, n_amb_dev = _stability_screen_program(
-            spec, pos_tol)(conds, ys, ok_dev)
+            spec, pos_tol, backend)(conds, ys, ok_dev)
         return cert, amb, int(np.asarray(n_amb_dev))  # scalar round trip
 
     certified, ambiguous, n_amb = call_with_backend_retry(
@@ -547,12 +575,13 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     res = batch_steady_state(spec, conds, x0=x0, opts=_fast_pass_opts(opts),
                              mesh=mesh)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
-                         check_stability, pos_jac_tol)
+                         check_stability, pos_jac_tol,
+                         backend=_resolve_backend(mesh=mesh))
 
 
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                   opts: SolverOptions, tof_mask, check_stability: bool,
-                  pos_jac_tol: float):
+                  pos_jac_tol: float, backend: Optional[str] = None):
     """Shared sweep tail: rescue ladder, stability verdict/demote loop,
     TOF/activity -- everything downstream of the first solving pass
     (used by both sweep_steady_state and continuation_sweep)."""
@@ -582,7 +611,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf)
     if check_stability:
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
-                                ok=res.success)
+                                ok=res.success, backend=backend)
         # Converged-but-UNSTABLE lanes (e.g. the middle root of a
         # bistable mechanism) get the facade's random-restart treatment
         # (api/system.py find_steady: up to 3 retries from fresh
@@ -602,7 +631,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                              seed=17 + round_i, use_x0=False)
             stable = stability_mask(spec, conds, res.x,
                                     pos_tol=pos_jac_tol,
-                                    ok=res.success)
+                                    ok=res.success, backend=backend)
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts}
     if check_stability:
@@ -696,7 +725,8 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     res = jax.tree_util.tree_map(
         lambda *leaves: jnp.concatenate(leaves, axis=0)[inv], *stage_res)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
-                         check_stability, pos_jac_tol)
+                         check_stability, pos_jac_tol,
+                         backend=_resolve_backend())
 
 
 def _polish_opts(opts: SolverOptions) -> SolverOptions:
@@ -799,10 +829,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     n_prog = 1
     if check_stability:
         ok = jnp.ones(n, dtype=bool)
+        backend = _resolve_backend()
 
         def run_screen():
-            out = _stability_screen_program(spec, pos_jac_tol)(conds, ys,
-                                                               ok)
+            out = _stability_screen_program(spec, pos_jac_tol,
+                                            backend)(conds, ys, ok)
             np.asarray(out[2])
             return out
 

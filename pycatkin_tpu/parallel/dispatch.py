@@ -61,17 +61,34 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                    work_dir: Optional[str] = None,
                    tof_terms=None, check_stability: bool = False,
                    worker_env: Optional[dict] = None,
-                   timeout: Optional[float] = None) -> dict:
+                   timeout: Optional[float] = None,
+                   on_failure: str = "raise") -> dict:
     """Run ``sweep_steady_state`` over ``conds`` split across
     ``n_workers`` independent processes; returns the merged result dict
     (same keys as the in-process sweep, lane order preserved).
 
     ``sim``: a built System (serialized to JSON for the workers).
     ``conds``: lane-batched Conditions.
+
+    ``on_failure`` is the degradation policy for failed/timed-out
+    worker blocks (the DCN tier's rung of the robustness ladder,
+    robustness/ladder.py):
+
+    - ``"raise"``  (default): fail fast, inputs + partial results left
+      in ``work_dir`` for debugging -- the historical behavior.
+    - ``"salvage"``: re-run each failed block IN-PROCESS (the parent
+      becomes the host-fallback worker; this imports JAX into the
+      otherwise JAX-free parent), recording a degradation event per
+      block; only if the in-process re-solve also fails does the
+      error propagate.
     """
     import tempfile
 
     from ..utils.io import save_system_json
+
+    if on_failure not in ("raise", "salvage"):
+        raise ValueError(f"on_failure must be 'raise' or 'salvage', "
+                         f"got {on_failure!r}")
 
     own_dir = work_dir is None
     if own_dir:
@@ -91,6 +108,7 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
         out_path = os.path.join(work_dir, f"result_{i}.npz")
         save_conditions(in_path, block)
         cfg = {"model": model_path, "conds": in_path, "out": out_path,
+               "block": i,
                "tof_terms": list(tof_terms) if tof_terms else None,
                "check_stability": bool(check_stability)}
         cfg_path = os.path.join(work_dir, f"job_{i}.json")
@@ -128,6 +146,27 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    if failed and on_failure == "salvage":
+        # Host-fallback rung of the ladder at the DCN tier: the block
+        # inputs are still on disk, so re-solve them here in-process
+        # (CPU/host devices of the parent) rather than losing the whole
+        # sweep to one dead worker.
+        from ..utils.profiling import record_event
+        still_failed = []
+        for i in failed:
+            cfg_path = os.path.join(work_dir, f"job_{i}.json")
+            record_event("degradation", label=f"dispatch:block:{i}",
+                         rung="host-fallback",
+                         detail="worker process failed/timed out; "
+                                "re-solving block in-process")
+            try:
+                _worker(cfg_path, inject_faults=False)
+            except Exception as exc:  # noqa: BLE001 - reported below
+                record_event("degradation", label=f"dispatch:block:{i}",
+                             rung="abandoned",
+                             detail=f"in-process re-solve failed: {exc}")
+                still_failed.append(i)
+        failed = still_failed
     if failed:
         raise RuntimeError(
             f"dispatch_sweep: worker block(s) {failed} failed or timed "
@@ -147,13 +186,24 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
     return out
 
 
-def _worker(cfg_path: str) -> None:
+def _worker(cfg_path: str, inject_faults: bool = True) -> None:
     with open(cfg_path) as f:
         cfg = json.load(f)
 
     import pycatkin_tpu as pk
     from .. import engine
+    from ..robustness import faults
     from .batch import sweep_steady_state
+
+    # Deterministic fault-injection site at the dispatch boundary:
+    # workers inherit PYCATKIN_FAULTS via the environment, so a plan
+    # targeting "dispatch:block:<i>" fires inside the subprocess (the
+    # resulting nonzero exit is what the parent's salvage path handles).
+    # The parent's in-process salvage re-run passes inject_faults=False:
+    # an injected fault models the remote worker/device, and the host
+    # fallback is by construction a different device.
+    if inject_faults:
+        faults.inject(f"dispatch:block:{cfg.get('block', 0)}")
 
     sim = pk.read_from_input_file(cfg["model"])
     conds = load_conditions(cfg["conds"])

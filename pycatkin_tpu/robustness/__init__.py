@@ -1,0 +1,38 @@
+"""Failure subsystem: deterministic fault injection, the graceful-
+degradation ladder, and journaled checkpoint/resume for chunked sweeps.
+
+See docs/failure_model.md for the full failure model; the three layers:
+
+- :mod:`.faults`  -- scripted transient/permanent/NaN/stall faults at
+  named dispatch sites (env ``PYCATKIN_FAULTS`` or
+  :func:`faults.fault_scope`), making every failure branch testable.
+- :mod:`.ladder`  -- per-chunk escalation: bounded retry -> requeue on
+  another device -> CPU host fallback -> salvage + structured report.
+- :mod:`.journal` / :mod:`.chunked` -- append-only sweep journal and
+  the resumable chunked sweep runner built on it.
+"""
+
+from .chunked import (chunk_verdict, chunked_sweep_steady_state,
+                      salvage_arrays)
+from .faults import (FaultPlan, FaultSpec, InjectedDeviceLossError,
+                     fault_scope)
+from .journal import (JournalMismatchError, SweepJournal,
+                      conditions_fingerprint)
+from .ladder import (ChunkAbandonedError, DegradationPolicy,
+                     run_chunk_with_ladder)
+
+__all__ = [
+    "ChunkAbandonedError",
+    "DegradationPolicy",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedDeviceLossError",
+    "JournalMismatchError",
+    "SweepJournal",
+    "chunk_verdict",
+    "chunked_sweep_steady_state",
+    "conditions_fingerprint",
+    "fault_scope",
+    "run_chunk_with_ladder",
+    "salvage_arrays",
+]

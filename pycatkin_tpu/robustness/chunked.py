@@ -1,0 +1,174 @@
+"""Chunked, journaled, degradation-tolerant steady-state sweeps.
+
+The production volcano/uncertainty sweeps dispatch the whole lane grid
+as one program: maximal throughput, but one exhausted failure forfeits
+everything. This runner trades a little dispatch overhead for
+durability: lanes are split into chunks, every chunk runs through the
+full sweep machinery (:func:`parallel.batch.sweep_steady_state`:
+fast pass + rescue ladder + optional stability verdict) under the
+graceful-degradation ladder (robustness/ladder.py), and each completed
+chunk is journaled (robustness/journal.py) so a killed run resumes by
+re-dispatching ONLY unfinished chunks -- with results bit-identical to
+an uninterrupted run (chunks are independent and the .npz round trip
+is lossless).
+
+Fault-injection sites: each chunk dispatch passes through
+``faults.inject("chunk:<i>")`` / ``faults.transform("chunk:<i>", out)``
+in addition to the retry-label sites inside the sweep itself, so
+tests can script a transient flake, NaN poisoning, a stall or a
+permanent loss at an exact chunk.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+import numpy as np
+
+from ..solvers.newton import SolverOptions
+from . import faults
+from .journal import SweepJournal, conditions_fingerprint
+from .ladder import DegradationPolicy, run_chunk_with_ladder
+
+# Result keys of a salvaged chunk, by sweep configuration (must mirror
+# parallel.batch._finish_sweep's output dict exactly so chunk arrays
+# concatenate).
+_INT_KEYS = ("iterations", "attempts")
+_BOOL_KEYS = ("success", "stable")
+
+
+def chunk_verdict(out) -> str | None:
+    """Post-hoc validation of one chunk's sweep result: a chunk whose
+    'converged' lanes carry non-finite solutions was poisoned upstream
+    (NaN chunk outputs are a known failure mode of remote execution),
+    and must escalate rather than enter the journal as good data."""
+    y = np.asarray(out["y"])
+    succ = np.asarray(out["success"]).astype(bool)
+    if succ.any() and not np.all(np.isfinite(y[succ])):
+        n = int(np.sum(~np.isfinite(y[succ]).all(axis=-1)))
+        return f"{n} converged lane(s) carry non-finite y"
+    return None
+
+
+def salvage_arrays(spec, n_lanes: int, tof_mask=None,
+                   check_stability: bool = False) -> dict:
+    """All-lanes-failed result block for a salvaged chunk (same keys/
+    shapes/dtypes as a real sweep result)."""
+    out = {
+        "y": np.full((n_lanes, spec.n_species), np.nan),
+        "success": np.zeros(n_lanes, dtype=bool),
+        "residual": np.full(n_lanes, np.inf),
+        "iterations": np.zeros(n_lanes, dtype=np.int64),
+        "attempts": np.zeros(n_lanes, dtype=np.int64),
+    }
+    if check_stability:
+        out["stable"] = np.zeros(n_lanes, dtype=bool)
+    if tof_mask is not None:
+        out["tof"] = np.full(n_lanes, np.nan)
+        out["activity"] = np.full(n_lanes, np.nan)
+    return out
+
+
+def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
+                               tof_mask=None,
+                               opts: SolverOptions = SolverOptions(),
+                               check_stability: bool = False,
+                               pos_jac_tol: float = 1e-2,
+                               journal: str | SweepJournal | None = None,
+                               resume: bool = False,
+                               policy: DegradationPolicy | None = None,
+                               verbose: bool = False):
+    """Run ``sweep_steady_state`` chunk by chunk with journaling and
+    graceful degradation.
+
+    ``journal``: directory path (or an open :class:`SweepJournal`) for
+    the on-disk journal; None runs unjournaled (ladder only).
+    ``resume``: replay an existing journal, re-dispatching only chunks
+    without a completed record. ``policy``: the degradation ladder
+    configuration; ``policy.salvage=False`` restores fail-fast
+    semantics (the journal still preserves completed chunks for a
+    later resume).
+
+    Returns ``(out, report)``: ``out`` is the assembled result dict
+    (host numpy arrays, original lane order); ``report`` is the
+    structured end-of-run degradation report::
+
+        {"n_chunks": ..., "chunk": ..., "reused": [ids],
+         "degraded": [ids], "salvaged": [ids], "n_failed_lanes": ...,
+         "events": [...]}
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.batch import sweep_steady_state
+
+    policy = policy or DegradationPolicy()
+    conds_np = jax.tree_util.tree_map(np.asarray, conds)
+    n = jax.tree_util.tree_leaves(conds_np)[0].shape[0]
+    chunk = max(1, min(int(chunk), n))
+    n_chunks = -(-n // chunk)
+
+    jr = journal
+    if isinstance(journal, (str, bytes)) or hasattr(journal, "__fspath__"):
+        fp = conditions_fingerprint(
+            conds_np, extra=(repr(opts), bool(check_stability),
+                             float(pos_jac_tol), int(chunk),
+                             None if tof_mask is None
+                             else np.asarray(tof_mask).tolist()))
+        jr = SweepJournal(str(journal), fingerprint=fp, n_lanes=n,
+                          chunk=chunk, resume=resume)
+    done = jr.completed() if jr is not None else {}
+
+    report = {"n_chunks": n_chunks, "chunk": chunk, "reused": [],
+              "degraded": [], "salvaged": [], "events": []}
+    parts: list[dict] = []
+    for ci in range(n_chunks):
+        a, b = ci * chunk, min(n, (ci + 1) * chunk)
+        site = f"chunk:{ci}"
+        if ci in done:
+            parts.append(jr.load_chunk(done[ci]))
+            report["reused"].append(ci)
+            continue
+        sub = jax.tree_util.tree_map(lambda x: x[a:b], conds_np)
+
+        def run(device=None, _sub=sub, _site=site):
+            faults.inject(_site)
+            ctx = (jax.default_device(device) if device is not None
+                   else nullcontext())
+            with ctx:
+                out = sweep_steady_state(
+                    spec, jax.tree_util.tree_map(jnp.asarray, _sub),
+                    tof_mask=tof_mask, opts=opts,
+                    check_stability=check_stability,
+                    pos_jac_tol=pos_jac_tol)
+                out = {k: np.asarray(v) for k, v in out.items()}
+            return faults.transform(_site, out)
+
+        out, events = run_chunk_with_ladder(
+            run, label=site, policy=policy, validate=chunk_verdict)
+        if out is None:
+            out = salvage_arrays(spec, b - a, tof_mask, check_stability)
+            status = "salvaged"
+            report["salvaged"].append(ci)
+        else:
+            status = "done"
+            if events:
+                report["degraded"].append(ci)
+        n_failed = int(np.sum(~np.asarray(out["success"], dtype=bool)))
+        if jr is not None:
+            jr.record_chunk(ci, a, b, status, arrays=out, events=events,
+                            n_failed=n_failed)
+        report["events"].extend(events)
+        parts.append(out)
+        if verbose:
+            import sys
+            print(f"chunk {ci + 1}/{n_chunks} [{a}:{b}] {status} "
+                  f"({n_failed} failed lane(s))", file=sys.stderr,
+                  flush=True)
+
+    keys = parts[0].keys()
+    out = {k: np.concatenate([p[k] for p in parts], axis=0)
+           for k in keys}
+    report["n_failed_lanes"] = int(
+        np.sum(~np.asarray(out["success"], dtype=bool)))
+    return out, report

@@ -1,0 +1,168 @@
+"""Graceful-degradation ladder: escalate per chunk instead of crashing.
+
+A failure that exhausts the bounded retry today kills the whole sweep.
+This module turns per-chunk failure handling into an explicit policy
+that escalates through rungs, each strictly cheaper in outcome but
+strictly more likely to complete:
+
+  1. **retry**          -- bounded full-jitter retry with an overall
+                           deadline (utils/retry.py) on the original
+                           device; absorbs transport/compile flakes.
+  2. **requeue**        -- re-dispatch the chunk on a DIFFERENT device
+                           of the local topology (device loss / one
+                           sick chip shouldn't sink the run).
+  3. **host fallback**  -- run the chunk on the CPU backend: slow, but
+                           a working host beats a dead accelerator.
+  4. **salvage**        -- mark the chunk's lanes failed and continue;
+                           the sweep ends with a structured report of
+                           degraded chunks instead of a dead process.
+
+Every transition records a degradation event (also mirrored into
+utils/profiling's diagnostics log), so a run that limped home says so
+in its structured report -- silent degradation is the one outcome this
+module refuses to produce.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass
+
+from ..utils import profiling
+from ..utils.retry import call_with_backend_retry
+
+
+@dataclass(frozen=True)
+class DegradationPolicy:
+    """Per-chunk escalation policy.
+
+    attempts/base_delay_s/max_delay_s/deadline_s parameterize rung 1's
+    bounded retry (full-jitter exponential backoff, overall deadline);
+    the booleans enable/disable the later rungs. ``rung_attempts``
+    bounds the retry wrapped around each requeue/host-fallback
+    dispatch (those rungs still deserve flake absorption, but a
+    cheaper one)."""
+    attempts: int = 3
+    base_delay_s: float = 1.0
+    max_delay_s: float = 30.0
+    deadline_s: float | None = None
+    requeue: bool = True
+    host_fallback: bool = True
+    salvage: bool = True
+    rung_attempts: int = 2
+
+
+class ChunkAbandonedError(RuntimeError):
+    """Every enabled rung failed and salvage is disabled."""
+
+
+def _alternate_device(exclude=None):
+    """A device different from ``exclude`` (or from the default
+    device), or None when the topology has only one."""
+    import jax
+    try:
+        devs = list(jax.devices())
+    except RuntimeError:
+        return None
+    if len(devs) < 2:
+        return None
+    avoid = exclude if exclude is not None else devs[0]
+    for d in devs:
+        if d != avoid:
+            return d
+    return None
+
+
+def _host_device():
+    import jax
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
+def _first_line(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: " + \
+        (str(exc).splitlines() or [""])[0][:200]
+
+
+def run_chunk_with_ladder(run, *, label: str,
+                          policy: DegradationPolicy = DegradationPolicy(),
+                          validate=None, events: list | None = None):
+    """Drive ``run`` through the degradation ladder.
+
+    ``run(device=None)``: the chunk callable; ``device`` (a
+    ``jax.Device``) re-targets the dispatch for the requeue and
+    host-fallback rungs. ``validate(out) -> str | None``: post-hoc
+    verdict on a completed call (e.g. NaN-poisoned outputs); a non-None
+    string escalates exactly like an exception.
+
+    Returns ``(result, events)`` where ``result`` is None when the
+    salvage rung was reached (the caller owns building salvage
+    arrays). Raises :class:`ChunkAbandonedError` when salvage is
+    disabled and every enabled rung failed.
+    """
+    events = [] if events is None else events
+
+    def note(rung: str, detail: str):
+        ev = {"label": label, "rung": rung, "detail": detail}
+        events.append(ev)
+        profiling.record_event("degradation", **ev)
+        print(f"degradation[{label}]: {rung}: {detail}",
+              file=sys.stderr, flush=True)
+
+    def attempt(rung: str, **kwargs):
+        """One rung's dispatch (retry-wrapped) + validation. Returns
+        (ok, out)."""
+        out = call_with_backend_retry(
+            run, attempts=(policy.attempts if rung == "retry"
+                           else policy.rung_attempts),
+            base_delay_s=policy.base_delay_s,
+            max_delay_s=policy.max_delay_s,
+            deadline_s=policy.deadline_s, label=label, **kwargs)
+        bad = validate(out) if validate is not None else None
+        if bad:
+            note(rung, f"result rejected: {bad}")
+            return False, None
+        return True, out
+
+    t0 = time.monotonic()
+    try:
+        ok, out = attempt("retry")
+        if ok:
+            return out, events
+    except Exception as exc:                 # noqa: BLE001 -- escalates
+        note("retry", f"exhausted: {_first_line(exc)}")
+
+    if policy.requeue:
+        dev = _alternate_device()
+        if dev is not None:
+            note("requeue", f"re-dispatching on {dev}")
+            try:
+                ok, out = attempt("requeue", device=dev)
+                if ok:
+                    note("requeue", "recovered")
+                    return out, events
+            except Exception as exc:         # noqa: BLE001 -- escalates
+                note("requeue", f"failed: {_first_line(exc)}")
+
+    if policy.host_fallback:
+        dev = _host_device()
+        if dev is not None:
+            note("host-fallback", f"re-dispatching on {dev}")
+            try:
+                ok, out = attempt("host-fallback", device=dev)
+                if ok:
+                    note("host-fallback", "recovered")
+                    return out, events
+            except Exception as exc:         # noqa: BLE001 -- escalates
+                note("host-fallback", f"failed: {_first_line(exc)}")
+
+    if policy.salvage:
+        note("salvage", f"marking lanes failed after "
+                        f"{time.monotonic() - t0:.1f} s of escalation")
+        return None, events
+    raise ChunkAbandonedError(
+        f"{label}: every enabled degradation rung failed and salvage "
+        "is disabled")

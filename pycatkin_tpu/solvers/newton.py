@@ -501,7 +501,25 @@ def deflation_basis_for_spec(spec) -> "np.ndarray":
 LYAPUNOV_MAX_DIM = 8
 
 
-def lyapunov_certified_stable(J, Q, tol):
+def effective_unit_roundoff(dtype, backend: str | None = None) -> float:
+    """Effective unit roundoff of f64 arithmetic on ``backend``.
+
+    CPU and CUDA/ROCm GPUs have native IEEE f64 (finfo eps); anything
+    else -- TPU, axon, future accelerators -- is assumed to emulate f64
+    as double-f32 pairs with ~49 mantissa bits (constants.py:33), i.e.
+    16x finfo eps per op (sound-first default). ``backend=None`` reads
+    ``jax.default_backend()`` at CALL time -- callers that own a mesh/
+    device set must pass the platform of the devices the program will
+    actually run on (ADVICE r5: a program explicitly placed on a
+    non-default device must not inherit the default backend's margin,
+    and cached programs must not bake in a stale choice)."""
+    if backend is None:
+        backend = jax.default_backend()
+    native_f64 = backend in ("cpu", "gpu", "cuda", "rocm")
+    return (1.0 if native_f64 else 16.0) * float(jnp.finfo(dtype).eps)
+
+
+def lyapunov_certified_stable(J, Q, tol, eps_eff: float | None = None):
     """Device-side SOUND one-way stability certificate via a deflated
     Lyapunov solve (jittable / vmappable; small m only).
 
@@ -539,7 +557,23 @@ def lyapunov_certified_stable(J, Q, tol):
 
     J: [n, n]; Q: [n, m] static with m >= 1 (callers gate m == 0 --
     an all-conservation spectrum -- to the other tiers); tol: scalar.
-    Returns a bool scalar.
+    ``eps_eff``: the executing backend's unit roundoff
+    (:func:`effective_unit_roundoff`) -- the caller that owns the mesh/
+    devices must supply it so a cached jitted program cannot bake in a
+    margin chosen from a stale ``jax.default_backend()`` read; None
+    falls back to the default backend AT TRACE TIME (only safe when
+    the program runs on the default backend). Returns a bool scalar.
+
+    NOTE on rigor (ADVICE r5): the ||R||_2 margin via E is a genuine
+    Higham-style forward-error bound, but the positive-definiteness
+    margin below (64 eps m max|S| on unpivoted elimination pivots) is
+    EMPIRICALLY CALIBRATED, not a proven backward-error bound --
+    element growth in unpivoted elimination on a near-indefinite S can
+    in principle exceed it. "Never falsely certify" therefore rests on
+    the 40k-matrix adversarial sweep plus the 800-matrix per-test-run
+    re-check (tests/test_verdicts.py), and on the analytically exact
+    (spot-checked at rtol 1e-6) conservation deflation -- see
+    docs/failure_model.md for the empirical-status summary.
     """
     m = Q.shape[1]
     Qc = jnp.asarray(Q, dtype=J.dtype)
@@ -553,21 +587,13 @@ def lyapunov_certified_stable(J, Q, tol):
     R = A.T @ S + S @ A + eye
     R = 0.5 * (R + R.T)
     pmax = jnp.max(jnp.abs(S))
-    # Effective unit roundoff, chosen per backend at trace time: CPU
-    # has true IEEE f64 (eps = 2^-53); TPU-class backends emulate f64
-    # as double-f32 pairs with ~49 mantissa bits (constants.py:33), so
-    # their per-op rounding error is ~16x finfo eps. Using the
-    # backend's real roundoff keeps the forward-error matrix E a
-    # genuine bound (soundness) without inflating it where the
-    # arithmetic is better than the worst case (coverage: a uniform
-    # 64x factor was measured to cost ~14 % of volcano-lane
-    # certifications whose CPU-arithmetic residuals are provably
-    # fine).
-    # Sound-first default: only backends KNOWN to have native IEEE f64
-    # (CPU, CUDA/ROCm GPUs) get the tight 1x margin; anything else --
-    # TPU, axon, future accelerators -- is assumed emulated (16x).
-    native_f64 = jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
-    eps = (1.0 if native_f64 else 16.0) * jnp.finfo(J.dtype).eps
+    # Effective unit roundoff of the EXECUTING backend (see
+    # effective_unit_roundoff: finfo eps on native-f64 CPU/GPU, 16x on
+    # emulated-f64 accelerators). The caller that owns the devices
+    # passes eps_eff explicitly; the default-backend fallback here is
+    # trace-time and only sound when the program runs there.
+    eps = (effective_unit_roundoff(J.dtype) if eps_eff is None
+           else float(eps_eff))
     absA, absS = jnp.abs(A), jnp.abs(S)
     E = 4.0 * (m + 2) * eps * (absA.T @ absS + absS @ absA + eye)
     E = 0.5 * (E + E.T)

@@ -282,3 +282,36 @@ def save_results(path: str, **arrays):
 def load_results(path: str) -> dict:
     with np.load(path, allow_pickle=False) as data:
         return {k: data[k] for k in data.files}
+
+
+def append_json_line(path: str, record: dict) -> None:
+    """Durably append one JSON object as a line to a ``.jsonl`` file
+    (the sweep journal's manifest format, robustness/journal.py): the
+    line is flushed AND fsynced before returning, so a record that
+    this function reported written survives a process kill."""
+    import os
+    line = json.dumps(record, sort_keys=True)
+    with open(path, "a") as fh:
+        fh.write(line + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def read_json_lines(path: str) -> list:
+    """Read a ``.jsonl`` file written by :func:`append_json_line`,
+    tolerating a truncated FINAL line (a kill mid-append leaves at most
+    one partial record, which is dropped; a corrupt non-final line
+    still raises -- that is damage, not a crash artifact)."""
+    records = []
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break
+            raise
+    return records

@@ -28,6 +28,37 @@ from contextlib import contextmanager
 import numpy as np
 
 
+# ---------------------------------------------------------------------
+# Structured diagnostics event log. The degradation ladder
+# (robustness/ladder.py) and the dispatchers record every non-fatal
+# failure-handling decision here (retry exhaustion, requeue, host
+# fallback, salvage), so a run that limped home carries machine-
+# readable evidence of HOW -- drivers fold drain_events() into their
+# end-of-run reports instead of scraping stderr.
+_EVENTS: list = []
+
+
+def record_event(kind: str, **fields) -> dict:
+    """Append one structured diagnostics event ({'kind': kind, 't':
+    monotonic seconds, **fields}) and return it."""
+    ev = {"kind": str(kind), "t": round(time.monotonic(), 3), **fields}
+    _EVENTS.append(ev)
+    return ev
+
+
+def peek_events(kind: str | None = None) -> list:
+    """Events recorded so far (optionally filtered by kind), without
+    clearing them."""
+    return [e for e in _EVENTS if kind is None or e["kind"] == kind]
+
+
+def drain_events() -> list:
+    """Return AND clear the recorded events (end-of-run report hook)."""
+    out = list(_EVENTS)
+    _EVENTS.clear()
+    return out
+
+
 def checksum_fence():
     """Build a jitted pytree -> scalar checksum for honest timing.
 

@@ -13,10 +13,26 @@ is always safe for the jitted-program call sites here.
 Only errors matching known-transient transport/compiler-service
 signatures are retried; genuine program errors (shape mismatches,
 NaN-checking, OOM with its own semantics) re-raise immediately.
+Transient classification covers both ``JaxRuntimeError`` text markers
+and raw gRPC-style exceptions that expose a status ``code()`` (the
+tunnel occasionally surfaces those undressed, before jax wraps them).
+
+Backoff is full-jitter exponential (AWS architecture-blog recipe:
+``sleep ~ U(0, min(cap, base * 2**i))``) -- synchronized lanes/workers
+retrying a shared flaky service must not stampede it in lockstep -- and
+an optional overall ``deadline_s`` bounds the total time spent inside
+one retried unit (a sweep chunk must fail into the degradation ladder,
+not sleep forever). Retry logging is capped per call so a long retry
+storm cannot flood stderr.
+
+Every attempt also passes through the fault-injection hooks
+(robustness/faults.py) keyed by the call's ``label``, which is how the
+test suite exercises each branch of this module deterministically.
 """
 
 from __future__ import annotations
 
+import random
 import sys
 import time
 
@@ -38,10 +54,49 @@ TRANSIENT_MARKERS = (
     "failed to connect",
 )
 
+# gRPC status codes that are infrastructure-transient (retry-safe for
+# pure re-dispatch). RESOURCE_EXHAUSTED is deliberately absent: on
+# accelerators it usually means device OOM, which a retry cannot fix.
+TRANSIENT_GRPC_CODES = frozenset(
+    {"UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED"})
+
+# Stop printing per-retry lines after this many within one call; a
+# single summary line marks the suppression.
+_LOG_CAP = 3
+
+# Process-wide jitter source (full-jitter backoff); call sites needing
+# reproducible delays pass their own ``rng``.
+_jitter_rng = random.Random()
+
+
+def _grpc_status_name(exc: BaseException) -> str | None:
+    """Status-code name of a gRPC-style exception (``exc.code()``
+    returning an enum with ``.name``), or None."""
+    code = getattr(exc, "code", None)
+    if not callable(code):
+        return None
+    try:
+        status = code()
+    except Exception:                        # pragma: no cover
+        return None
+    name = getattr(status, "name", None)
+    return name if isinstance(name, str) else None
+
 
 def is_transient_backend_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a transport/compile-service flake
-    rather than a program error."""
+    rather than a program error.
+
+    Two classes qualify: ``jax.errors.JaxRuntimeError`` whose text
+    carries a :data:`TRANSIENT_MARKERS` signature, and raw gRPC-style
+    exceptions (``grpc.RpcError`` or anything exposing ``code()``)
+    whose status is in :data:`TRANSIENT_GRPC_CODES`. Arbitrary Python
+    exceptions that merely CONTAIN a marker string (e.g.
+    ``ValueError("remote_compile")``) stay non-transient -- a program
+    error must never be silently re-run."""
+    status = _grpc_status_name(exc)
+    if status is not None:
+        return status.upper() in TRANSIENT_GRPC_CODES
     try:
         import jax
         if not isinstance(exc, jax.errors.JaxRuntimeError):
@@ -53,22 +108,68 @@ def is_transient_backend_error(exc: BaseException) -> bool:
 
 
 def call_with_backend_retry(fn, *args, attempts: int = 3,
-                            base_delay_s: float = 2.0, label: str = "",
-                            **kwargs):
+                            base_delay_s: float = 2.0,
+                            max_delay_s: float = 60.0,
+                            deadline_s: float | None = None,
+                            jitter: bool = True, rng=None,
+                            label: str = "", **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying up to ``attempts`` total
-    tries on transient backend errors (exponential backoff, logged to
-    stderr). Non-transient exceptions propagate immediately; the last
-    transient failure propagates after the final attempt."""
+    tries on transient backend errors.
+
+    Backoff before attempt ``i+1`` is ``min(max_delay_s,
+    base_delay_s * 2**i)``, drawn uniformly from ``(0, that]`` when
+    ``jitter`` is on (full jitter -- desynchronizes fleets of workers
+    hammering one recovering service; pass ``rng`` for deterministic
+    tests). ``deadline_s`` bounds the TOTAL elapsed time across
+    attempts and sleeps: when the next backoff would cross it, the
+    current failure propagates instead (the caller's degradation
+    ladder owns what happens next).
+
+    Non-transient exceptions propagate immediately; the last transient
+    failure propagates after the final attempt. Per-retry log lines are
+    capped at ``_LOG_CAP`` per call."""
+    from ..robustness import faults
+
+    rng = rng if rng is not None else _jitter_rng
+    start = time.monotonic()
+    logged = 0
     for i in range(attempts):
+        plan = faults.active_plan()
         try:
-            return fn(*args, **kwargs)
+            if plan is not None:
+                plan.on_call(label)
+            out = fn(*args, **kwargs)
+            if plan is not None:
+                out = plan.on_result(label, out)
+            return out
         except Exception as exc:  # noqa: BLE001 -- filtered below
             if i + 1 >= attempts or not is_transient_backend_error(exc):
                 raise
-            delay = base_delay_s * (2.0 ** i)
-            print(f"transient backend error{f' in {label}' if label else ''}"
-                  f" (attempt {i + 1}/{attempts}, retrying in "
-                  f"{delay:.0f} s): {str(exc).splitlines()[0][:200]}",
-                  file=sys.stderr, flush=True)
+            delay = min(max_delay_s, base_delay_s * (2.0 ** i))
+            if jitter:
+                delay = rng.uniform(0.0, delay)
+            if deadline_s is not None and \
+                    time.monotonic() - start + delay > deadline_s:
+                raise
+            # Absorbed flakes must still be visible in the structured
+            # diagnostics, not only on stderr: a sweep that "worked"
+            # after 40 retries is a degraded run.
+            from . import profiling
+            profiling.record_event(
+                "retry", label=label, attempt=i + 1, attempts=attempts,
+                delay_s=round(delay, 3),
+                error=str(exc).splitlines()[0][:200])
+            if logged < _LOG_CAP:
+                print(f"transient backend error"
+                      f"{f' in {label}' if label else ''}"
+                      f" (attempt {i + 1}/{attempts}, retrying in "
+                      f"{delay:.1f} s): "
+                      f"{str(exc).splitlines()[0][:200]}",
+                      file=sys.stderr, flush=True)
+                logged += 1
+                if logged == _LOG_CAP and attempts - (i + 1) > 1:
+                    print(f"(suppressing further retry logs"
+                          f"{f' for {label}' if label else ''})",
+                          file=sys.stderr, flush=True)
             time.sleep(delay)
     raise AssertionError("unreachable")      # pragma: no cover

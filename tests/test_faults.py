@@ -1,0 +1,210 @@
+"""Deterministic fault injection + degradation ladder unit tests.
+
+Every failure mode the production backend exhibits (transport flakes,
+stalls, NaN-poisoned outputs, device loss) is a scriptable event
+(robustness/faults.py); these tests pin the plan semantics and walk the
+degradation ladder (robustness/ladder.py) through each rung.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pycatkin_tpu.robustness import (ChunkAbandonedError, DegradationPolicy,
+                                     FaultPlan, FaultSpec,
+                                     InjectedDeviceLossError, fault_scope,
+                                     run_chunk_with_ladder)
+from pycatkin_tpu.robustness import faults
+from pycatkin_tpu.utils.retry import (call_with_backend_retry,
+                                      is_transient_backend_error)
+
+pytestmark = pytest.mark.faults
+
+_FAST = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002)
+
+
+# ---------------------------------------------------------------------
+# FaultPlan semantics
+
+
+def test_fault_plan_site_matching_and_occurrence():
+    plan = FaultPlan([FaultSpec(site="chunk:*", kind="transient",
+                                index=1, times=1)])
+    plan.on_call("chunk:0")                       # occurrence 0: no fire
+    with pytest.raises(jax.errors.JaxRuntimeError) as ei:
+        plan.on_call("chunk:0")                   # occurrence 1: fires
+    assert is_transient_backend_error(ei.value)
+    plan.on_call("chunk:0")                       # times=1: spent
+    plan.on_call("other site")                    # no match, no fire
+    assert plan.log == [{"site": "chunk:0", "occurrence": 1,
+                         "kind": "transient"}]
+
+
+def test_fault_plan_permanent_is_not_transient():
+    plan = FaultPlan([{"site": "s", "kind": "permanent", "times": None}])
+    with pytest.raises(InjectedDeviceLossError) as ei:
+        plan.on_call("s")
+    assert not is_transient_backend_error(ei.value)
+    with pytest.raises(InjectedDeviceLossError):
+        plan.on_call("s")                         # times=None: every call
+
+
+def test_fault_plan_nan_poisons_chosen_lanes():
+    plan = FaultPlan([{"site": "s", "kind": "nan", "lanes": [1]}])
+    plan.on_call("s")
+    out = plan.on_result("s", {"y": np.ones((3, 2)),
+                               "n": np.arange(3),
+                               "tag": "keep"})
+    assert np.isnan(out["y"][1]).all()
+    assert np.isfinite(out["y"][[0, 2]]).all()
+    assert np.array_equal(out["n"], np.arange(3))    # ints untouched
+    assert out["tag"] == "keep"
+
+
+def test_fault_plan_stall_sleeps():
+    plan = FaultPlan([{"site": "s", "kind": "stall", "delay_s": 0.05}])
+    t0 = time.monotonic()
+    plan.on_call("s")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_fault_plan_from_env_roundtrip():
+    text = json.dumps([{"site": "chunk:2", "kind": "transient"},
+                       {"site": "*", "kind": "nan", "lanes": [0, 3]}])
+    plan = FaultPlan.from_env(text)
+    assert [s.kind for s in plan.specs] == ["transient", "nan"]
+    assert plan.specs[1].lanes == (0, 3)
+    assert FaultPlan.from_env("") is None
+    with pytest.raises(ValueError):
+        FaultPlan([{"site": "s", "kind": "meteor"}])
+
+
+def test_fault_scope_installs_and_restores():
+    assert faults.active_plan() is None
+    plan = FaultPlan([{"site": "s", "kind": "transient"}])
+    with fault_scope(plan):
+        assert faults.active_plan() is plan
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            faults.inject("s")
+    assert faults.active_plan() is None
+    faults.inject("s")                            # no-op without a plan
+
+
+# ---------------------------------------------------------------------
+# Faults through the retry layer (label = site)
+
+
+def test_injected_transient_absorbed_by_retry():
+    plan = FaultPlan([{"site": "solve", "kind": "transient"}])
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        return calls["n"]
+
+    with fault_scope(plan):
+        out = call_with_backend_retry(fn, attempts=3, base_delay_s=0.001,
+                                      label="solve")
+    assert out == 1          # first dispatch faulted BEFORE fn ran
+    assert [e["kind"] for e in plan.log] == ["transient"]
+
+
+def test_injected_transient_exhaustion_reraises():
+    plan = FaultPlan([{"site": "solve", "kind": "transient",
+                       "times": None}])
+    with fault_scope(plan):
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            call_with_backend_retry(lambda: 1, attempts=3,
+                                    base_delay_s=0.001, label="solve")
+    assert len(plan.log) == 3                     # one per attempt
+
+
+def test_injected_stall_trips_retry_deadline():
+    plan = FaultPlan([{"site": "solve", "kind": "stall",
+                       "delay_s": 0.05, "times": None},
+                      {"site": "solve", "kind": "transient",
+                       "times": None}])
+    t0 = time.monotonic()
+    with fault_scope(plan):
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            call_with_backend_retry(lambda: 1, attempts=50,
+                                    base_delay_s=0.04, jitter=False,
+                                    deadline_s=0.1, label="solve")
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------
+# The degradation ladder rung by rung
+
+
+def test_ladder_clean_call_passes_through():
+    out, events = run_chunk_with_ladder(lambda device=None: 7,
+                                        label="c", policy=_FAST)
+    assert out == 7 and events == []
+
+
+def test_ladder_requeue_recovers_on_other_device():
+    """A permanent fault on the first dispatch only: the retry rung
+    fails fast (device loss is not transient), requeue's re-dispatch
+    (different device) succeeds."""
+    seen = []
+
+    plan = FaultPlan([{"site": "c", "kind": "permanent", "times": 1}])
+
+    def run(device=None):
+        seen.append(device)
+        return "ok"
+
+    with fault_scope(plan):
+        out, events = run_chunk_with_ladder(run, label="c", policy=_FAST)
+    assert out == "ok"
+    rungs = [e["rung"] for e in events]
+    assert "requeue" in rungs
+    assert seen[-1] is not None                   # re-targeted device
+
+
+def test_ladder_nan_validation_escalates_and_recovers():
+    plan = FaultPlan([{"site": "c", "kind": "nan", "times": 1}])
+
+    def run(device=None):
+        return {"y": np.ones((2, 2))}
+
+    def validate(out):
+        return ("poisoned" if not np.isfinite(out["y"]).all() else None)
+
+    with fault_scope(plan):
+        out, events = run_chunk_with_ladder(run, label="c", policy=_FAST,
+                                            validate=validate)
+    assert np.isfinite(out["y"]).all()
+    assert any("rejected" in e["detail"] for e in events)
+
+
+def test_ladder_salvage_returns_none_and_reports():
+    from pycatkin_tpu.utils import profiling
+
+    profiling.drain_events()
+    plan = FaultPlan([{"site": "c", "kind": "permanent", "times": None}])
+    with fault_scope(plan):
+        out, events = run_chunk_with_ladder(
+            lambda device=None: 1, label="c", policy=_FAST)
+    assert out is None
+    rungs = [e["rung"] for e in events]
+    assert rungs[-1] == "salvage"
+    # mirrored into the structured diagnostics log
+    evs = profiling.drain_events()
+    assert any(e["kind"] == "degradation" and e["rung"] == "salvage"
+               for e in evs)
+
+
+def test_ladder_salvage_disabled_raises():
+    plan = FaultPlan([{"site": "c", "kind": "permanent", "times": None}])
+    pol = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002,
+                            salvage=False)
+    with fault_scope(plan):
+        with pytest.raises(ChunkAbandonedError):
+            run_chunk_with_ladder(lambda device=None: 1, label="c",
+                                  policy=pol)

@@ -1,0 +1,206 @@
+"""Journaled chunked sweep: checkpoint/resume + degradation reporting.
+
+End-to-end acceptance drills for the robustness subsystem on the CPU
+backend (self-contained synthetic mechanism, no reference tree):
+
+- an injected transient flake is absorbed with ZERO failed lanes and
+  the degradation is visible in the structured diagnostics;
+- a run killed mid-sweep by an injected permanent device loss (with
+  salvage disabled, i.e. fail-fast) resumes from its journal,
+  re-dispatches ONLY unfinished chunks, and produces results
+  bit-identical to an uninterrupted run;
+- a journal never resumes against different conditions (fingerprint
+  guard).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel.batch import broadcast_conditions
+from pycatkin_tpu.robustness import (ChunkAbandonedError, DegradationPolicy,
+                                     FaultPlan, JournalMismatchError,
+                                     SweepJournal, chunked_sweep_steady_state,
+                                     conditions_fingerprint, fault_scope,
+                                     salvage_arrays)
+from pycatkin_tpu.robustness.journal import MANIFEST
+from pycatkin_tpu.utils import profiling
+from pycatkin_tpu.utils.io import append_json_line, read_json_lines
+
+pytestmark = pytest.mark.faults
+
+_FAST = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002)
+_N = 12
+_CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=10, n_reactions=12)
+    spec = sim.spec
+    conds = broadcast_conditions(sim.conditions(), _N)
+    conds = conds._replace(T=np.linspace(450.0, 650.0, _N))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+@pytest.fixture(scope="module")
+def reference_run(problem):
+    """The uninterrupted run every resumed run must match bit-for-bit."""
+    spec, conds, mask = problem
+    out, report = chunked_sweep_steady_state(spec, conds, chunk=_CHUNK,
+                                             tof_mask=mask)
+    assert report["n_failed_lanes"] == 0
+    return out
+
+
+def _assert_bit_identical(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    for k in a:
+        assert np.array_equal(a[k], b[k], equal_nan=True), k
+
+
+def test_transient_fault_absorbed_zero_failed_lanes(problem,
+                                                    reference_run):
+    """Acceptance: injected transient flake at one chunk is absorbed by
+    the retry rung -- no failed lanes, no salvage, and the event shows
+    up in the structured diagnostics."""
+    spec, conds, mask = problem
+    profiling.drain_events()
+    plan = FaultPlan([{"site": "chunk:1", "kind": "transient"}])
+    with fault_scope(plan):
+        out, report = chunked_sweep_steady_state(
+            spec, conds, chunk=_CHUNK, tof_mask=mask, policy=_FAST)
+    assert [e["kind"] for e in plan.log] == ["transient"]
+    assert report["n_failed_lanes"] == 0
+    assert report["salvaged"] == []
+    _assert_bit_identical(out, reference_run)
+    evs = profiling.drain_events()
+    assert any(e["kind"] == "retry" and e["label"] == "chunk:1"
+               for e in evs)
+
+
+def test_kill_and_resume_bit_identical(problem, reference_run, tmp_path):
+    """Acceptance: kill the sweep mid-run via an injected permanent
+    device loss (fail-fast policy), restart with resume=True, verify
+    only unfinished chunks are re-dispatched and the assembled result
+    is bit-identical to the uninterrupted run."""
+    spec, conds, mask = problem
+    jdir = str(tmp_path / "journal")
+    fail_fast = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002,
+                                  requeue=False, host_fallback=False,
+                                  salvage=False)
+    plan = FaultPlan([{"site": "chunk:1", "kind": "permanent",
+                       "times": None}])
+    with fault_scope(plan):
+        with pytest.raises(ChunkAbandonedError):
+            chunked_sweep_steady_state(spec, conds, chunk=_CHUNK,
+                                       tof_mask=mask, journal=jdir,
+                                       policy=fail_fast)
+    # The journal durably holds exactly the chunks completed pre-kill.
+    recs = read_json_lines(os.path.join(jdir, MANIFEST))
+    done_before = [r["chunk_id"] for r in recs if r.get("kind") == "chunk"
+                   and r["status"] == "done"]
+    assert done_before == [0]
+
+    out, report = chunked_sweep_steady_state(spec, conds, chunk=_CHUNK,
+                                             tof_mask=mask, journal=jdir,
+                                             resume=True)
+    assert report["reused"] == [0]                # only chunk 0 replayed
+    assert report["n_failed_lanes"] == 0
+    _assert_bit_identical(out, reference_run)
+
+    # A second resume reuses everything.
+    out2, report2 = chunked_sweep_steady_state(spec, conds, chunk=_CHUNK,
+                                               tof_mask=mask, journal=jdir,
+                                               resume=True)
+    assert report2["reused"] == [0, 1, 2]
+    _assert_bit_identical(out2, reference_run)
+
+
+def test_salvaged_chunk_marks_lanes_and_resolves_on_resume(
+        problem, reference_run, tmp_path):
+    """With salvage enabled, a permanently dead chunk yields NaN/failed
+    lanes and the run completes; the salvaged chunk is NOT reused on
+    resume -- the restart re-solves it cleanly."""
+    spec, conds, mask = problem
+    jdir = str(tmp_path / "journal")
+    pol = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002,
+                            requeue=False, host_fallback=False)
+    plan = FaultPlan([{"site": "chunk:2", "kind": "permanent",
+                       "times": None}])
+    with fault_scope(plan):
+        out, report = chunked_sweep_steady_state(
+            spec, conds, chunk=_CHUNK, tof_mask=mask, journal=jdir,
+            policy=pol)
+    assert report["salvaged"] == [2]
+    assert report["n_failed_lanes"] == _CHUNK
+    sl = slice(2 * _CHUNK, 3 * _CHUNK)
+    assert np.isnan(out["y"][sl]).all()
+    assert not out["success"][sl].any()
+
+    out2, report2 = chunked_sweep_steady_state(
+        spec, conds, chunk=_CHUNK, tof_mask=mask, journal=jdir,
+        resume=True)
+    assert report2["reused"] == [0, 1]            # salvaged chunk re-run
+    assert report2["salvaged"] == []
+    _assert_bit_identical(out2, reference_run)
+
+
+def test_resume_rejects_different_conditions(problem, tmp_path):
+    spec, conds, mask = problem
+    jdir = str(tmp_path / "journal")
+    chunked_sweep_steady_state(spec, conds, chunk=_CHUNK, tof_mask=mask,
+                               journal=jdir)
+    with pytest.raises(JournalMismatchError):
+        chunked_sweep_steady_state(spec, conds._replace(T=conds.T + 1.0),
+                                   chunk=_CHUNK, tof_mask=mask,
+                                   journal=jdir, resume=True)
+
+
+def test_fresh_journal_refuses_existing_manifest(tmp_path):
+    jdir = str(tmp_path / "journal")
+    SweepJournal(jdir, fingerprint="abc", n_lanes=4, chunk=2)
+    with pytest.raises(RuntimeError, match="resume=True"):
+        SweepJournal(jdir, fingerprint="abc", n_lanes=4, chunk=2)
+
+
+def test_manifest_tolerates_truncated_final_line(tmp_path):
+    """A kill mid-append leaves at most one partial line; replay drops
+    it. A corrupt NON-final line is damage and still raises."""
+    path = str(tmp_path / "m.jsonl")
+    append_json_line(path, {"kind": "header", "version": 1})
+    append_json_line(path, {"kind": "chunk", "chunk_id": 0})
+    with open(path, "a") as fh:
+        fh.write('{"kind": "chu')                 # torn write
+    recs = read_json_lines(path)
+    assert [r["kind"] for r in recs] == ["header", "chunk"]
+
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as fh:
+        fh.write('{"kind": "hea\n{"kind": "chunk", "chunk_id": 0}\n')
+    with pytest.raises(Exception):
+        read_json_lines(bad)
+
+
+def test_conditions_fingerprint_sensitivity(problem):
+    spec, conds, mask = problem
+    base = conditions_fingerprint(conds, extra=("a",))
+    assert base == conditions_fingerprint(conds, extra=("a",))
+    assert base != conditions_fingerprint(
+        conds._replace(T=np.asarray(conds.T) + 1e-9), extra=("a",))
+    assert base != conditions_fingerprint(conds, extra=("b",))
+
+
+def test_salvage_arrays_match_sweep_schema(problem, reference_run):
+    spec, _, mask = problem
+    salv = salvage_arrays(spec, 3, tof_mask=mask, check_stability=False)
+    ref_keys = set(reference_run.keys())
+    assert set(salv.keys()) == ref_keys
+    for k in ref_keys:
+        assert salv[k].dtype == reference_run[k].dtype, k
+        assert salv[k].shape[1:] == reference_run[k].shape[1:], k
+    assert not salv["success"].any()

@@ -1,0 +1,131 @@
+"""Restricted-unpickler hardening for the reference pickle converter.
+
+tools/convert_reference_pickle.py loads untrusted reference pickles; a
+module-root allowlist would be an arbitrary-code-execution hole
+(``builtins.eval`` is one REDUCE opcode away). These tests pin the
+exact-name allowlist: numpy array/scalar reconstruction and plain
+builtin containers deserialize as themselves, everything else in the
+guarded roots raises, and unknown third-party classes still shim to
+inert attribute bags (the converter's whole design).
+"""
+
+import io
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from convert_reference_pickle import (_RefUnpickler, convert,  # noqa: E402
+                                      load_reference_pickle)
+
+
+def _loads(raw: bytes):
+    return _RefUnpickler(io.BytesIO(raw)).load()
+
+
+@pytest.mark.parametrize("protocol", [2, pickle.HIGHEST_PROTOCOL])
+def test_benign_numpy_payload_roundtrips(tmp_path, protocol):
+    """Arrays, numpy scalars, dtypes and builtin containers survive
+    both the legacy (reference-era) and current pickle protocols."""
+    import collections
+    payload = {"a": np.arange(5.0), "m": np.ones((2, 3), dtype=np.int32),
+               "s": np.float64(3.5), "d": np.dtype("float32"),
+               "od": collections.OrderedDict(x=1), "t": (1, [2.0], {3}),
+               "b": b"raw"}
+    p = tmp_path / "ref.pckl"
+    with open(p, "wb") as fh:
+        pickle.dump(payload, fh, protocol=protocol)
+    got = load_reference_pickle(str(p))
+    assert np.array_equal(got["a"], payload["a"])
+    assert got["m"].dtype == np.int32
+    assert got["s"] == 3.5 and got["d"] == np.dtype("float32")
+    assert got["od"] == payload["od"] and got["t"] == payload["t"]
+    assert got["b"] == b"raw"
+
+
+def test_malicious_reduce_eval_raises(tmp_path):
+    """The classic RCE gadget -- REDUCE on builtins.eval -- must raise,
+    not execute."""
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("__import__('os').system('true')",))
+
+    p = tmp_path / "evil.pckl"
+    with open(p, "wb") as fh:
+        pickle.dump(Evil(), fh)
+    with pytest.raises(pickle.UnpicklingError, match="allowlist"):
+        load_reference_pickle(str(p))
+
+
+@pytest.mark.parametrize("gadget", ["eval", "exec", "getattr",
+                                    "__import__", "compile", "open"])
+def test_builtin_gadgets_rejected(gadget):
+    raw = f"cbuiltins\n{gadget}\n.".encode()
+    with pytest.raises(pickle.UnpicklingError):
+        _loads(raw)
+
+
+def test_numpy_non_reconstruction_names_rejected():
+    """numpy is an allowed *root* but only the array-reconstruction
+    names pass; arbitrary numpy callables (frombuffer, load with
+    pickle, ...) are refused rather than resolved or silently
+    shimmed (a shimmed numpy internal would corrupt array data)."""
+    with pytest.raises(pickle.UnpicklingError):
+        _loads(pickle.dumps(np.frombuffer))
+    with pytest.raises(pickle.UnpicklingError):
+        _loads(b"cnumpy\nload\n.")
+
+
+def _reference_style_pickle(**attrs) -> bytes:
+    """Pickle bytes of a fake ``pycatkin.classes.state.State`` instance
+    (built in a throwaway module, exactly what a real reference pickle
+    references by module path)."""
+    import types
+
+    modname = "pycatkin.classes.state"
+    names = ["pycatkin", "pycatkin.classes", "pycatkin.classes.state"]
+    State = type("State", (), {"__module__": modname})
+    try:
+        for nm in names:                 # parents too: pickle imports
+            sys.modules[nm] = types.ModuleType(nm)
+        sys.modules[modname].State = State
+        obj = State()
+        obj.__dict__.update(attrs)
+        return pickle.dumps(obj)
+    finally:
+        for nm in names:
+            sys.modules.pop(nm, None)
+
+
+def test_unknown_modules_still_shim_to_inert_bags():
+    """Reference/ASE classes (and even os.system smuggled under an
+    unguarded root) deserialize as inert attribute bags: no import, no
+    constructor, no call."""
+    obj = _loads(_reference_style_pickle(name="CO"))
+    assert type(obj).__name__ == "State"
+    assert type(obj).__module__ == "pycatkin.classes.state"
+    assert obj.name == "CO"
+    assert "pycatkin.classes.state" not in sys.modules  # never imported
+
+    # A callable smuggled from an unguarded module root builds an inert
+    # instance instead of executing.
+    raw = b"cos\nsystem\n(S'true'\ntR."
+    obj = _loads(raw)
+    assert type(obj).__name__ == "system"
+    assert obj._shim_args == ("true",)
+
+
+def test_shimmed_state_converts_to_json_snippet():
+    """The conversion path still works end to end on a shimmed
+    reference State pickle."""
+    raw = _reference_style_pickle(name="CO", state_type="adsorbate",
+                                  Gelec=-1.5, freq=[12.0, 34.0])
+    doc = convert(_loads(raw))
+    assert doc == {"states": {"CO": {"state_type": "adsorbate",
+                                     "Gelec": -1.5,
+                                     "freq": [12.0, 34.0]}}}

@@ -111,3 +111,134 @@ def test_transient_classifier():
     assert not is_transient_backend_error(jax.errors.JaxRuntimeError(
         "INVALID_ARGUMENT: dot_general shape mismatch"))
     assert not is_transient_backend_error(ValueError("remote_compile"))
+
+
+class _FakeStatus:
+    def __init__(self, name):
+        self.name = name
+
+
+class _FakeRpcError(Exception):
+    """gRPC-style exception: status via a callable ``code()``."""
+
+    def __init__(self, status):
+        super().__init__(f"rpc failed with {status}")
+        self._status = status
+
+    def code(self):
+        return _FakeStatus(self._status)
+
+
+def test_transient_classifier_grpc_status_codes():
+    """Raw gRPC-style exceptions classify by status code, not text:
+    UNAVAILABLE/DEADLINE_EXCEEDED/ABORTED are transient;
+    RESOURCE_EXHAUSTED (device OOM) and INVALID_ARGUMENT are not."""
+    assert is_transient_backend_error(_FakeRpcError("UNAVAILABLE"))
+    assert is_transient_backend_error(_FakeRpcError("DEADLINE_EXCEEDED"))
+    assert is_transient_backend_error(_FakeRpcError("ABORTED"))
+    assert not is_transient_backend_error(
+        _FakeRpcError("RESOURCE_EXHAUSTED"))
+    assert not is_transient_backend_error(
+        _FakeRpcError("INVALID_ARGUMENT"))
+
+
+def test_retry_recovers_from_grpc_transient():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _FakeRpcError("UNAVAILABLE")
+        return "ok"
+
+    assert call_with_backend_retry(flaky, attempts=3,
+                                   base_delay_s=0.01) == "ok"
+    assert calls["n"] == 2
+
+
+def test_retry_deadline_bounds_total_time():
+    """When the next backoff would cross deadline_s, the failure
+    propagates instead of sleeping past the budget."""
+    import time
+
+    calls = {"n": 0}
+
+    def always_flaky():
+        calls["n"] += 1
+        raise jax.errors.JaxRuntimeError("UNAVAILABLE: socket closed")
+
+    t0 = time.monotonic()
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        call_with_backend_retry(always_flaky, attempts=50,
+                                base_delay_s=10.0, jitter=False,
+                                deadline_s=0.05)
+    assert time.monotonic() - t0 < 5.0
+    assert calls["n"] == 1           # first 10 s backoff already > 0.05
+
+
+def test_retry_full_jitter_uses_rng_and_stays_bounded():
+    """Full jitter draws each delay from U(0, min(cap, base*2^i)] via
+    the provided rng -- deterministic under a seeded rng, bounded by
+    the exponential envelope."""
+    import random
+
+    delays = []
+
+    class _Rng(random.Random):
+        def uniform(self, a, b):
+            delays.append((a, b))
+            return 0.0               # don't actually sleep in the test
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: socket closed")
+        return 1
+
+    assert call_with_backend_retry(flaky, attempts=4, base_delay_s=0.5,
+                                   max_delay_s=1.5, rng=_Rng(0)) == 1
+    # Envelope: min(1.5, 0.5 * 2**i) for i = 0, 1, 2.
+    assert [b for (a, b) in delays] == [0.5, 1.0, 1.5]
+    assert all(a == 0.0 for (a, b) in delays)
+
+
+def test_retry_log_capped(capsys):
+    """Per-retry stderr lines stop after the cap; a suppression notice
+    marks the cut."""
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 8:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: socket closed")
+        return 1
+
+    assert call_with_backend_retry(flaky, attempts=8, base_delay_s=0.001,
+                                   jitter=False, label="capped") == 1
+    err = capsys.readouterr().err
+    assert err.count("transient backend error in capped") == 3
+    assert "suppressing further retry logs" in err
+
+
+def test_retry_records_structured_event():
+    """An absorbed flake must be visible in the diagnostics event log,
+    not only on stderr (a run that 'worked' after retries is a
+    degraded run)."""
+    from pycatkin_tpu.utils import profiling
+
+    profiling.drain_events()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: socket closed")
+        return 1
+
+    call_with_backend_retry(flaky, attempts=3, base_delay_s=0.001,
+                            label="evt")
+    evs = profiling.drain_events()
+    assert any(e["kind"] == "retry" and e["label"] == "evt"
+               for e in evs)

@@ -34,10 +34,38 @@ import sys
 
 import numpy as np
 
-# Modules whose classes deserialize as themselves. Everything else --
-# pycatkin.*, ase.*, arbitrary user modules -- becomes a _Shim subclass
-# carrying only the pickled __dict__/state.
-_ALLOWED_MODULES = ("numpy", "builtins", "collections", "__builtin__")
+# Exact (module, name) pairs that deserialize as themselves: the numpy
+# reconstruction machinery reference pickles actually use, plus the safe
+# builtin containers/scalars. Everything else in these module roots --
+# numpy funcs, builtins.eval/exec/getattr, os via a collections path,
+# etc. -- is REJECTED (a whole-module-root allowlist is an arbitrary-
+# code-execution hole: ``builtins.eval`` is one REDUCE away). Classes
+# from any other module (pycatkin.*, ase.*, user code) become a _Shim
+# subclass carrying only the pickled __dict__/state.
+_ALLOWED_NAMES = frozenset(
+    [("numpy", "ndarray"), ("numpy", "dtype"),
+     ("numpy.core.multiarray", "_reconstruct"),
+     ("numpy.core.multiarray", "scalar"),
+     ("numpy._core.multiarray", "_reconstruct"),   # numpy >= 2 paths
+     ("numpy._core.multiarray", "scalar"),
+     ("numpy.core.numeric", "_frombuffer"),        # pickle protocol 5
+     ("numpy._core.numeric", "_frombuffer"),
+     ("_codecs", "encode"),           # legacy (proto<=2) numpy dtypes
+     ("collections", "OrderedDict"),
+     ("collections", "defaultdict"),
+     ("collections", "deque")]
+    + [(mod, name)
+       for mod in ("builtins", "__builtin__")
+       for name in ("list", "dict", "set", "tuple", "frozenset",
+                    "bytearray", "bytes", "str", "int", "float",
+                    "complex", "bool")])
+
+# Module roots the allowlist covers: a disallowed name under one of
+# these roots is an ERROR (never shimmed -- shimming numpy internals
+# would silently corrupt array data; shimming builtins would mask an
+# exploit attempt). Names under any other root shim as before.
+_GUARDED_ROOTS = ("numpy", "builtins", "collections", "__builtin__",
+                  "_codecs")
 
 
 class _Shim:
@@ -61,9 +89,15 @@ class _Shim:
 
 class _RefUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
-        root = module.split(".")[0]
-        if root in _ALLOWED_MODULES:
+        if (module, name) in _ALLOWED_NAMES:
             return super().find_class(module, name)
+        root = module.split(".")[0]
+        if root in _GUARDED_ROOTS:
+            raise pickle.UnpicklingError(
+                f"refusing to resolve {module}.{name}: not on the "
+                "conversion allowlist (only numpy array/scalar "
+                "reconstruction and plain builtin containers may "
+                "deserialize as themselves)")
         return type(name, (_Shim,), {"__module__": module})
 
 

@@ -8,6 +8,12 @@ while_loop runs to its OWN max-iteration lane instead of the global
 worst lane.
 
 Run: python tools/exp_chunked_volcano.py
+
+Durable mode: ``--journal DIR [--chunk N] [--resume]`` runs the grid
+through the journaled, degradation-tolerant chunked runner
+(pycatkin_tpu.robustness) instead of the timing experiment -- a killed
+run restarted with ``--resume`` re-dispatches only unfinished chunks
+(docs/failure_model.md).
 """
 
 import os
@@ -74,7 +80,47 @@ def sweep(spec, conds, mask, chunk):
     return pb._finish_sweep(spec, conds, res, opts, mask, False, 1e-2)
 
 
+def journal_main(argv):
+    """Journaled chunked sweep with checkpoint/resume (--journal mode);
+    uses bench._build_problem so it also runs without the reference
+    tree (synthetic fallback)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="exp_chunked_volcano.py")
+    ap.add_argument("--journal", required=True)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--chunk", type=int, default=8192)
+    args = ap.parse_args(argv)
+
+    from bench import _build_problem
+    from pycatkin_tpu.robustness import chunked_sweep_steady_state
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    sim, spec, conds, mask, metric, _ = _build_problem()
+
+    t0 = time.perf_counter()
+    out, report = chunked_sweep_steady_state(
+        spec, conds, chunk=args.chunk, tof_mask=mask,
+        opts=sim.solver_options(), check_stability=True,
+        journal=args.journal, resume=args.resume, verbose=True)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": metric + " (journaled chunked mode)",
+        "chunk": report["chunk"], "n_chunks": report["n_chunks"],
+        "reused_chunks": report["reused"],
+        "degraded_chunks": report["degraded"],
+        "salvaged_chunks": report["salvaged"],
+        "n_failed_lanes": report["n_failed_lanes"],
+        "converged": int(np.sum(np.asarray(out["success"]))),
+        "wall_s": round(wall, 2)}), flush=True)
+
+
 def main():
+    if any(a.startswith("--journal") for a in sys.argv[1:]):
+        journal_main(sys.argv[1:])
+        return
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
     sim = pk.read_from_input_file(
