@@ -1,12 +1,14 @@
 # Test lanes. Tier-1 (the default gate) runs the fast suite on the CPU
 # backend; the faults lane isolates the fault-injection / degradation /
-# journal-resume tests (they are also part of tier-1 -- pytest marker
-# `faults` stays inside the default `not slow` selection).
+# journal-resume tests and the validate lane the input-validation-gate
+# / quarantine tests (both markers stay inside the default `not slow`
+# selection). `lint-faults` statically checks that every fault-site
+# label in pycatkin_tpu/ is documented in docs/failure_model.md.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test test-faults test-all
+.PHONY: test test-faults test-validate test-all lint-faults
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -14,5 +16,11 @@ test:
 test-faults:
 	$(PYTEST) -m faults
 
+test-validate:
+	$(PYTEST) -m validate
+
 test-all:
 	$(PYTEST) -m ''
+
+lint-faults:
+	python tools/lint_fault_sites.py

@@ -315,59 +315,108 @@ def main():
 
 
 def journal_main(argv):
-    """Durable chunked sweep with checkpoint/resume (--journal mode).
+    """Durable chunked sweep with checkpoint/resume (--journal mode)
+    and/or per-lane failure forensics (--forensics).
 
     Prints exactly one JSON line: a durability report (chunks run/
     reused/degraded/salvaged, failed lanes, wall), not a throughput
-    record.
+    record. With ``--forensics`` the line carries a ``forensics`` key:
+    the structured per-lane failure report of
+    :func:`pycatkin_tpu.robustness.sweep_failure_report` (quarantined
+    lanes, verdict-test breakdown, residuals, ladder history), and the
+    human rendering goes to stderr.
     """
     import argparse
 
     ap = argparse.ArgumentParser(
-        prog="bench.py", description="journaled chunked volcano sweep")
-    ap.add_argument("--journal", required=True,
+        prog="bench.py",
+        description="journaled chunked volcano sweep / lane forensics")
+    ap.add_argument("--journal", default=None,
                     help="journal directory (created if missing)")
     ap.add_argument("--resume", action="store_true",
                     help="replay the journal, re-dispatching only "
                          "unfinished chunks")
     ap.add_argument("--chunk", type=int, default=4096,
                     help="lanes per chunk (default 4096)")
+    ap.add_argument("--forensics", action="store_true",
+                    help="attach the per-lane failure forensics report "
+                         "to the JSON result (runs a plain sweep when "
+                         "no --journal is given)")
     args = ap.parse_args(argv)
+    if not args.journal and not args.forensics:
+        ap.error("need --journal DIR and/or --forensics")
+    if args.resume and not args.journal:
+        ap.error("--resume requires --journal DIR")
 
     from pycatkin_tpu.utils.cache import enable_persistent_cache
     enable_persistent_cache()
 
     import jax
 
-    from pycatkin_tpu.robustness import chunked_sweep_steady_state
+    from pycatkin_tpu.utils import profiling
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
     sim, spec, conds, mask, metric, _ = _build_problem()
+    profiling.drain_events()        # forensics sees only this run
 
-    t0 = time.perf_counter()
-    out, report = chunked_sweep_steady_state(
-        spec, conds, chunk=args.chunk, tof_mask=mask,
-        opts=sim.solver_options(), check_stability=True,
-        journal=args.journal, resume=args.resume, verbose=True)
-    wall = time.perf_counter() - t0
+    if args.journal:
+        from pycatkin_tpu.robustness import chunked_sweep_steady_state
 
-    n = int(np.asarray(out["success"]).shape[0])
-    result = {
-        "metric": metric + " (journaled chunked mode)",
-        "journal": args.journal,
-        "resumed": bool(args.resume),
-        "chunk": report["chunk"],
-        "n_chunks": report["n_chunks"],
-        "reused_chunks": report["reused"],
-        "degraded_chunks": report["degraded"],
-        "salvaged_chunks": report["salvaged"],
-        "n_failed_lanes": report["n_failed_lanes"],
-        "converged": int(np.sum(np.asarray(out["success"]))),
-        "n_points": n,
-        "wall_s": round(wall, 2),
-    }
+        t0 = time.perf_counter()
+        out, report = chunked_sweep_steady_state(
+            spec, conds, chunk=args.chunk, tof_mask=mask,
+            opts=sim.solver_options(), check_stability=True,
+            journal=args.journal, resume=args.resume, verbose=True)
+        wall = time.perf_counter() - t0
+
+        n = int(np.asarray(out["success"]).shape[0])
+        result = {
+            "metric": metric + " (journaled chunked mode)",
+            "journal": args.journal,
+            "resumed": bool(args.resume),
+            "chunk": report["chunk"],
+            "n_chunks": report["n_chunks"],
+            "reused_chunks": report["reused"],
+            "degraded_chunks": report["degraded"],
+            "salvaged_chunks": report["salvaged"],
+            "n_failed_lanes": report["n_failed_lanes"],
+            "converged": int(np.sum(np.asarray(out["success"]))),
+            "n_points": n,
+            "wall_s": round(wall, 2),
+        }
+        events = list(report.get("events", []))
+    else:
+        from pycatkin_tpu.parallel.batch import sweep_steady_state
+
+        t0 = time.perf_counter()
+        out = sweep_steady_state(spec, conds, tof_mask=mask,
+                                 opts=sim.solver_options(),
+                                 check_stability=True)
+        n_ok = int(np.sum(np.asarray(out["success"])))
+        wall = time.perf_counter() - t0
+
+        n = int(np.asarray(out["success"]).shape[0])
+        result = {
+            "metric": metric + " (forensics mode)",
+            "converged": n_ok,
+            "n_points": n,
+            "wall_s": round(wall, 2),
+        }
+        events = []
+
+    if args.forensics:
+        from pycatkin_tpu.robustness import (format_failure_report,
+                                             sweep_failure_report)
+        # Ladder/retry/quarantine events recorded during THIS run that
+        # a chunked report does not already carry.
+        events = events + [ev for ev in profiling.drain_events()
+                           if ev.get("kind") in ("degradation", "retry")]
+        forensics = sweep_failure_report(out, conds=conds, events=events)
+        result["forensics"] = forensics
+        log(format_failure_report(forensics))
+
     print(json.dumps(result))
 
 

@@ -96,10 +96,11 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
                                     sim_system.params.get("n_out", 300)))
     ys, ok = batch_transient(spec, batched, grid, sim_system._ode_options())
     if not bool(np.all(np.asarray(ok))):
-        bad = [values[i] for i in np.flatnonzero(~np.asarray(ok))]
-        print(f"Warning: transient integration incomplete for sweep "
-              f"values {bad}; downstream results for those lanes are "
-              "unreliable", file=sys.stderr)
+        idx = np.flatnonzero(~np.asarray(ok))
+        bad = [values[i] for i in idx]
+        print(f"Warning: transient integration incomplete for lanes "
+              f"{idx.tolist()} (sweep values {bad}); downstream results "
+              "for those lanes are unreliable", file=sys.stderr)
     finals = np.asarray(ys[:, -1, :])
 
     if steady_state_solve:
@@ -125,16 +126,24 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
         res, _ = run_chunk_with_ladder(run_steady, label="preset:steady",
                                        validate=reject_poisoned)
         if res is None:
-            print("Warning: batched steady solve failed on every "
-                  "degradation rung; falling back to transient finals "
-                  "(see diagnostics events)", file=sys.stderr)
+            from ..utils import profiling
+            lanes = list(range(len(values)))
+            detail = (f"steady solve failed on every degradation rung; "
+                      f"lanes {lanes} (sweep values {list(values)}) "
+                      f"degraded to transient finals")
+            profiling.record_event("degradation", label="preset:steady",
+                                   rung="transient-fallback",
+                                   detail=detail, lanes=lanes)
+            print(f"Warning: {detail} (see diagnostics events)",
+                  file=sys.stderr)
         else:
             finals = np.asarray(res.x)
             if not bool(np.all(np.asarray(res.success))):
-                bad = [values[i]
-                       for i in np.flatnonzero(~np.asarray(res.success))]
-                print(f"Warning: steady solve unconverged for sweep "
-                      f"values {bad}", file=sys.stderr)
+                idx = np.flatnonzero(~np.asarray(res.success))
+                bad = [values[i] for i in idx]
+                print(f"Warning: steady solve unconverged for lanes "
+                      f"{idx.tolist()} (sweep values {bad})",
+                      file=sys.stderr)
 
     rates = np.asarray(_net_rates_program(spec)(batched,
                                                 jnp.asarray(finals)))
@@ -148,10 +157,12 @@ def _sweep(sim_system, values, set_value, steady_state_solve, tof_terms,
         xis = np.asarray(xis)
         drc_ok = np.asarray(drc_ok)
         if not drc_ok.all():
-            bad = [values[i] for i in np.flatnonzero(~drc_ok)]
+            idx = np.flatnonzero(~drc_ok)
+            bad = [values[i] for i in idx]
             print(f"Warning: DRC perturbed steady solves unconverged for "
-                  f"sweep values {bad}; xi for those lanes is unreliable "
-                  "(prefer drc_mode='implicit')", file=sys.stderr)
+                  f"lanes {idx.tolist()} (sweep values {bad}); xi for "
+                  "those lanes is unreliable (prefer "
+                  "drc_mode='implicit')", file=sys.stderr)
         for i, v in enumerate(values):
             drcs[v] = dict(zip(spec.rnames, xis[i]))
     return finals, rates, drcs

@@ -152,10 +152,27 @@ class System:
 
     # ------------------------------------------------------------------
     # compilation
-    def build(self, force: bool = False):
+    def build(self, force: bool = False, strict: bool | None = None):
         """Compile the mechanism into the immutable ModelSpec (reference
-        system.py:167-186). Idempotent; re-run after structural changes."""
-        if self._spec is None or force:
+        system.py:167-186). Idempotent; re-run after structural changes.
+
+        ``strict`` controls the input-validation gate
+        (frontend/validate.py) run before compiling: True raises
+        :class:`~pycatkin_tpu.frontend.validate.ValidationError` on any
+        validation error, False skips the gate, None (default) follows
+        the ``PYCATKIN_VALIDATE`` environment variable
+        (strict|warn|off; default warn -- issues become
+        ``UserWarning``s and the build proceeds)."""
+        need_build = self._spec is None or force
+        # An explicit ``strict`` runs the gate even on an already-built
+        # system (revalidation without recompilation).
+        if need_build or strict is not None:
+            from ..frontend.validate import validate_system, validation_mode
+            mode = (validation_mode() if strict is None
+                    else ("strict" if strict else "off"))
+            if mode != "off":
+                validate_system(self).emit(mode)
+        if need_build:
             rtype = self.reactor.reactor_type if self.reactor else None
             rparams = self.reactor.params() if self.reactor else None
             self._spec = build_spec(self.states, self.reactions,

@@ -265,12 +265,15 @@ def steady_state(spec: ModelSpec, cond: Conditions,
     if x0 is None:
         x0 = y_base[dyn]
     groups_dyn = jnp.asarray(spec.groups)[:, dyn]
-    x, success, res, iters, attempts = newton.solve_steady(
+    (x, success, res, iters, attempts, rate_ok, pos_ok, sums_ok,
+     dt_exit) = newton.solve_steady(
         fscale, jac, jnp.asarray(x0), groups_dyn, opts, key=key,
         strategy=strategy)
     y_full = y_base.at[dyn].set(x)
     return SteadyStateResults(x=y_full, success=success, residual=res,
-                              iterations=iters, attempts=attempts)
+                              iterations=iters, attempts=attempts,
+                              rate_ok=rate_ok, pos_ok=pos_ok,
+                              sums_ok=sums_ok, dt_exit=dt_exit)
 
 
 def steady_jacobian(spec: ModelSpec, cond: Conditions, x_dyn):

@@ -97,13 +97,19 @@ def _tof_program(spec: ModelSpec):
     """One jitted program for (tof, activity, n_negative): everything
     derived from the solved states in a single dispatch (eager
     activity_from_tof on [lanes] cost ~1 s of per-op dispatch on the
-    tunneled backend)."""
-    def batched(conds, ys, mask):
+    tunneled backend).
+
+    ``ok`` is the per-lane good-lane mask (converged AND finite): the
+    cross-lane reduction counts negatives only over good lanes, so one
+    quarantined/unconverged lane cannot poison (NaN) or inflate the
+    aggregate while every per-lane output stays untouched."""
+    def batched(conds, ys, mask, ok):
         tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
                                                                    ys)
         act = engine.activity_from_tof(
             tofs, jax.tree_util.tree_leaves(conds.T)[0])
-        return tofs, act, jnp.sum(tofs < 0.0)
+        lane_ok = ok & jnp.isfinite(tofs)
+        return tofs, act, jnp.sum(lane_ok & (tofs < 0.0))
     return jax.jit(batched)
 
 
@@ -544,10 +550,22 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     # report their true total cost, not the capped fast-pass numbers.
     iters[idx] += np.asarray(out.iterations)[:len(idx)]
     atts[idx] += np.asarray(out.attempts)[:len(idx)]
+    # Forensic fields follow the iterate actually stored: recovered
+    # lanes take the rescue attempt's diagnostics; still-failed lanes
+    # keep the ones describing the res.x they still carry.
+    extra = {}
+    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+        cur = getattr(res, name)
+        new = getattr(out, name)
+        if cur is None or new is None:
+            continue
+        arr = np.array(cur)
+        arr[idx[got]] = np.asarray(new)[:len(idx)][got]
+        extra[name] = jnp.asarray(arr)
     return res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
                         residual=jnp.asarray(resid),
                         iterations=jnp.asarray(iters),
-                        attempts=jnp.asarray(atts)), n_remaining
+                        attempts=jnp.asarray(atts), **extra), n_remaining
 
 
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
@@ -579,16 +597,34 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
                          backend=_resolve_backend(mesh=mesh))
 
 
+def _quarantine_mask(res, quarantined=None):
+    """Per-lane NaN quarantine: lanes FLAGGED converged whose stored
+    solution or residual is non-finite are silently-poisoned results (a
+    `nan`-kind fault overwrites float leaves but cannot touch the bool
+    success flag; genuine device corruption looks the same). Demote
+    them to failed so the rescue ladder re-solves them and no
+    downstream reduction trusts their values. Returns ``(res, mask)``
+    with ``mask`` ORed into ``quarantined`` when given."""
+    x = jnp.asarray(res.x)
+    finite = (jnp.all(jnp.isfinite(x), axis=-1)
+              & jnp.isfinite(jnp.asarray(res.residual)))
+    q_new = jnp.asarray(res.success) & ~finite
+    q = q_new if quarantined is None else jnp.asarray(quarantined) | q_new
+    return res._replace(success=jnp.asarray(res.success) & finite), q
+
+
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                   opts: SolverOptions, tof_mask, check_stability: bool,
                   pos_jac_tol: float, backend: Optional[str] = None):
-    """Shared sweep tail: rescue ladder, stability verdict/demote loop,
-    TOF/activity -- everything downstream of the first solving pass
-    (used by both sweep_steady_state and continuation_sweep)."""
+    """Shared sweep tail: quarantine, rescue ladder, stability
+    verdict/demote loop, TOF/activity -- everything downstream of the
+    first solving pass (used by both sweep_steady_state and
+    continuation_sweep)."""
     # One scalar round trip decides the whole three-pass rescue ladder
     # (polish -> full PTC -> LM; the failed count then threads through
     # as a host int -- each materialization call costs ~0.1-1 s on the
-    # tunneled backend). The seeded passes use converged NEIGHBORS
+    # tunneled backend). The quarantine count rides the same transfer.
+    # The seeded passes use converged NEIGHBORS
     # (continuation):
     # measured on the 256x256 volcano's 269 phase-boundary lanes, the
     # ladder needs max 2 attempts / 216 accumulated iterations with
@@ -596,7 +632,11 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     # own failed iterates -- 5x less union work through the SAME
     # compiled program (the warm wall is latency-bound at this bucket
     # width, ~2 s either way; the headroom pays on harder grids).
-    nf = int(np.asarray(jnp.sum(~jnp.asarray(res.success))))
+    res, quar = _quarantine_mask(res)
+    counts = np.asarray(jnp.stack(
+        [jnp.sum(quar), jnp.sum(~jnp.asarray(res.success))]))
+    nq, nf = int(counts[0]), int(counts[1])
+    nf0 = nf
     if nf > 0:
         # Seeded near-Newton polish first: the cheap pass that
         # converges the whole tail in the common case (see
@@ -609,6 +649,16 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                           neighbor_seed=True, n_failed=nf)
     if nf > 0:
         res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf)
+    if nf0 > 0:
+        # Re-check after the ladder: a poisoned RESCUE dispatch can
+        # write fresh non-finite "successes" (fault sites rescue[*]);
+        # only the failure path pays this extra scalar round trip.
+        res, quar = _quarantine_mask(res, quar)
+        nq = int(np.asarray(jnp.sum(quar)))
+    if nq > 0:
+        from ..robustness.ladder import record_quarantine
+        record_quarantine(np.flatnonzero(np.asarray(quar)).tolist(),
+                          label="quarantine:sweep")
     if check_stability:
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
                                 ok=res.success, backend=backend)
@@ -633,7 +683,14 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                                     pos_tol=pos_jac_tol,
                                     ok=res.success, backend=backend)
     out = {"y": res.x, "success": res.success, "residual": res.residual,
-           "iterations": res.iterations, "attempts": res.attempts}
+           "iterations": res.iterations, "attempts": res.attempts,
+           "quarantined": quar}
+    # Per-lane forensic diagnostics (verdict breakdown + exit
+    # pseudo-step) ride along whenever the solver produced them.
+    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+        v = getattr(res, name, None)
+        if v is not None:
+            out[name] = v
     if check_stability:
         out["stable"] = stable
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
@@ -641,11 +698,12 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     if tof_mask is not None:
         mask_arr = jnp.asarray(tof_mask)
         tprog = _tof_program(spec)
+        ok_arr = jnp.asarray(out["success"])
 
         def run_tof():
             # The n_neg materialization doubles as the execution sync
             # inside the retried unit (see batch_steady_state).
-            t, a, nn = tprog(conds, res.x, mask_arr)
+            t, a, nn = tprog(conds, res.x, mask_arr, ok_arr)
             return t, a, int(np.asarray(nn))
 
         tofs, act, n_neg = call_with_backend_retry(run_tof,
@@ -841,9 +899,10 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         n_prog += 1
     if tof_mask is not None:
         mask_arr = jnp.asarray(tof_mask)
+        ok_all = jnp.ones(n, dtype=bool)
 
         def run_tof():
-            out = _tof_program(spec)(conds, ys, mask_arr)
+            out = _tof_program(spec)(conds, ys, mask_arr, ok_all)
             np.asarray(out[2])
             return out
 

@@ -18,8 +18,9 @@ from .faults import (FaultPlan, FaultSpec, InjectedDeviceLossError,
                      fault_scope)
 from .journal import (JournalMismatchError, SweepJournal,
                       conditions_fingerprint)
+from .forensics import format_failure_report, sweep_failure_report
 from .ladder import (ChunkAbandonedError, DegradationPolicy,
-                     run_chunk_with_ladder)
+                     record_quarantine, run_chunk_with_ladder)
 
 __all__ = [
     "ChunkAbandonedError",
@@ -33,6 +34,9 @@ __all__ = [
     "chunked_sweep_steady_state",
     "conditions_fingerprint",
     "fault_scope",
+    "format_failure_report",
+    "record_quarantine",
     "run_chunk_with_ladder",
     "salvage_arrays",
+    "sweep_failure_report",
 ]

@@ -34,7 +34,8 @@ from .ladder import DegradationPolicy, run_chunk_with_ladder
 # parallel.batch._finish_sweep's output dict exactly so chunk arrays
 # concatenate).
 _INT_KEYS = ("iterations", "attempts")
-_BOOL_KEYS = ("success", "stable")
+_BOOL_KEYS = ("success", "stable", "quarantined",
+              "rate_ok", "pos_ok", "sums_ok")
 
 
 def chunk_verdict(out) -> str | None:
@@ -60,6 +61,11 @@ def salvage_arrays(spec, n_lanes: int, tof_mask=None,
         "residual": np.full(n_lanes, np.inf),
         "iterations": np.zeros(n_lanes, dtype=np.int64),
         "attempts": np.zeros(n_lanes, dtype=np.int64),
+        "quarantined": np.zeros(n_lanes, dtype=bool),
+        "rate_ok": np.zeros(n_lanes, dtype=bool),
+        "pos_ok": np.zeros(n_lanes, dtype=bool),
+        "sums_ok": np.zeros(n_lanes, dtype=bool),
+        "dt_exit": np.full(n_lanes, np.nan),
     }
     if check_stability:
         out["stable"] = np.zeros(n_lanes, dtype=bool)
@@ -94,8 +100,13 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     structured end-of-run degradation report::
 
         {"n_chunks": ..., "chunk": ..., "reused": [ids],
-         "degraded": [ids], "salvaged": [ids], "n_failed_lanes": ...,
-         "events": [...]}
+         "degraded": [ids], "salvaged": [ids], "quarantined": [ids],
+         "n_failed_lanes": ..., "events": [...]}
+
+    A chunk with quarantined lanes that stayed failed after the rescue
+    ladder is journaled with status ``"quarantined"`` -- like
+    ``"salvaged"``, deliberately NOT a completed status, so a resume
+    re-solves exactly the lanes that degraded.
     """
     import jax
     import jax.numpy as jnp
@@ -120,7 +131,8 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     done = jr.completed() if jr is not None else {}
 
     report = {"n_chunks": n_chunks, "chunk": chunk, "reused": [],
-              "degraded": [], "salvaged": [], "events": []}
+              "degraded": [], "salvaged": [], "quarantined": [],
+              "events": []}
     parts: list[dict] = []
     for ci in range(n_chunks):
         a, b = ci * chunk, min(n, (ci + 1) * chunk)
@@ -154,6 +166,23 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
             status = "done"
             if events:
                 report["degraded"].append(ci)
+            # Quarantined lanes that the rescue ladder could NOT
+            # re-converge leave the chunk incomplete: record the
+            # quarantine rung against this chunk's site and journal a
+            # non-"done" status so a resume re-solves those lanes
+            # (status "quarantined" is not in journal._COMPLETE).
+            quar = np.asarray(out.get("quarantined",
+                                      np.zeros(b - a)), dtype=bool)
+            succ = np.asarray(out["success"], dtype=bool)
+            if (quar & ~succ).any():
+                lanes = (a + np.flatnonzero(quar & ~succ)).tolist()
+                events.append({
+                    "label": site, "rung": "quarantine",
+                    "detail": f"{len(lanes)} quarantined lane(s) "
+                              f"unrecovered; chunk left incomplete "
+                              f"for resume", "lanes": lanes})
+                status = "quarantined"
+                report["quarantined"].append(ci)
         n_failed = int(np.sum(~np.asarray(out["success"], dtype=bool)))
         if jr is not None:
             jr.record_chunk(ci, a, b, status, arrays=out, events=events,

@@ -1,0 +1,155 @@
+"""Host-side per-lane failure forensics for batched sweeps.
+
+A production sweep that limps home (quarantined lanes, exhausted
+rescues, demoted stability verdicts) must be able to SAY what happened
+to each lane without anyone re-running it under a debugger. This
+module assembles the forensic record from data the sweep already
+carries: the per-lane diagnostics of ``SteadyStateResults``
+(verdict-test breakdown, final residual, iterations/attempts, PTC
+pseudo-step at exit -- solvers/newton.py), the quarantine mask
+(parallel/batch.py), and the structured ladder/retry/quarantine events
+(robustness/ladder.py, utils/profiling.py).
+
+Everything is plain-JSON-serializable host data: reports travel
+through journals, ``bench.py --forensics`` output and test assertions
+unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Diagnostic keys lifted verbatim (as python scalars) from a sweep
+# result dict into each lane report, when present.
+_VERDICT_KEYS = ("rate_ok", "pos_ok", "sums_ok")
+_SCALAR_KEYS = ("residual", "dt_exit")
+_INT_KEYS = ("iterations", "attempts")
+_BOOL_KEYS = ("success", "quarantined", "stable")
+
+
+def _lane_conditions(conds, lane: int, n_lanes: int) -> dict:
+    """Per-lane condition values: every leaf of the conditions pytree
+    batched over the lane axis, as python scalars (or short lists)."""
+    import jax
+
+    out = {}
+    leaves = jax.tree_util.tree_flatten_with_path(conds)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.ndim == 0 or arr.shape[0] != n_lanes:
+            continue
+        name = "/".join(str(getattr(p, "name", getattr(p, "key",
+                                                       getattr(p, "idx",
+                                                               p))))
+                        for p in path)
+        val = arr[lane]
+        if val.ndim == 0:
+            out[name] = float(val)
+        elif val.size <= 8:
+            out[name] = [float(v) for v in val.ravel()]
+    return out
+
+
+def lane_report(out: dict, lane: int, conds=None,
+                events: list | None = None) -> dict:
+    """Forensic record for ONE lane of a sweep result dict.
+
+    ``out`` is the dict returned by ``sweep_steady_state`` /
+    ``chunked_sweep_steady_state`` (device or numpy arrays both fine).
+    ``events``: structured degradation/retry events; the lane's ladder
+    history is the subset naming this lane (events carrying a
+    ``lanes`` list) plus every lane-anonymous event (chunk-level rungs
+    apply to all their lanes).
+    """
+    n_lanes = len(np.asarray(out["success"]))
+    rep: dict = {"lane": int(lane)}
+    for k in _BOOL_KEYS:
+        if k in out:
+            rep[k] = bool(np.asarray(out[k])[lane])
+    for k in _INT_KEYS:
+        if k in out:
+            rep[k] = int(np.asarray(out[k])[lane])
+    for k in _SCALAR_KEYS:
+        if k in out:
+            rep[k] = float(np.asarray(out[k])[lane])
+    verdict = {k: bool(np.asarray(out[k])[lane])
+               for k in _VERDICT_KEYS if k in out}
+    if verdict:
+        rep["verdict"] = verdict
+    if "tof" in out:
+        rep["tof"] = float(np.asarray(out["tof"])[lane])
+    if conds is not None:
+        rep["conditions"] = _lane_conditions(conds, int(lane), n_lanes)
+    if events is not None:
+        rep["history"] = [ev for ev in events
+                          if int(lane) in ev.get("lanes", [])
+                          or "lanes" not in ev]
+    return rep
+
+
+def sweep_failure_report(out: dict, conds=None,
+                         events: list | None = None,
+                         max_lanes: int = 256) -> dict:
+    """Assemble the end-of-sweep forensic report: one record per
+    failed or quarantined lane (capped at ``max_lanes``; the cap is
+    recorded so truncation is never silent), plus sweep-level counts
+    and the full structured event log.
+
+    ``events`` should be the run's degradation/retry events -- e.g. a
+    chunked run's ``report["events"]``, or the matching subset of
+    ``utils.profiling.drain_events()`` for a plain sweep.
+    """
+    success = np.asarray(out["success"]).astype(bool)
+    n = len(success)
+    quarantined = np.asarray(
+        out.get("quarantined", np.zeros(n))).astype(bool)
+    bad = np.flatnonzero(~success | quarantined)
+    report = {
+        "n_lanes": int(n),
+        "n_failed": int(np.sum(~success)),
+        "n_quarantined": int(np.sum(quarantined)),
+        "quarantined_lanes": [int(i) for i in
+                              np.flatnonzero(quarantined)],
+        "truncated": bool(len(bad) > max_lanes),
+        "lanes": [lane_report(out, int(i), conds=conds, events=events)
+                  for i in bad[:max_lanes]],
+        "events": list(events or []),
+    }
+    return report
+
+
+def format_failure_report(report: dict) -> str:
+    """Human-readable rendering of :func:`sweep_failure_report`."""
+    lines = [f"sweep forensics: {report['n_failed']} failed / "
+             f"{report['n_quarantined']} quarantined of "
+             f"{report['n_lanes']} lane(s)"]
+    if report["quarantined_lanes"]:
+        lines.append(f"  quarantined lanes: "
+                     f"{report['quarantined_lanes']}")
+    for rep in report["lanes"]:
+        verdict = rep.get("verdict", {})
+        failing = [k for k, v in verdict.items() if not v]
+        bits = [f"lane {rep['lane']}:"]
+        if rep.get("quarantined"):
+            bits.append("QUARANTINED")
+        bits.append("converged" if rep.get("success") else "failed")
+        if failing:
+            bits.append(f"failing tests: {', '.join(failing)}")
+        if "residual" in rep:
+            bits.append(f"residual {rep['residual']:.3g}")
+        if "dt_exit" in rep:
+            bits.append(f"dt_exit {rep['dt_exit']:.3g}")
+        if "iterations" in rep:
+            bits.append(f"{rep['iterations']} it / "
+                        f"{rep.get('attempts', 0)} att")
+        lines.append("  " + " ".join(bits))
+        for key, val in rep.get("conditions", {}).items():
+            lines.append(f"    {key} = {val}")
+        for ev in rep.get("history", []):
+            lines.append(f"    {ev.get('label', '?')}: "
+                         f"{ev.get('rung', ev.get('kind', '?'))}: "
+                         f"{ev.get('detail', '')}")
+    if report.get("truncated"):
+        lines.append(f"  (lane reports truncated at "
+                     f"{len(report['lanes'])})")
+    return "\n".join(lines)

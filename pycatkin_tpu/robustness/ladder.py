@@ -57,6 +57,33 @@ class ChunkAbandonedError(RuntimeError):
     """Every enabled rung failed and salvage is disabled."""
 
 
+def record_quarantine(lanes, *, label: str = "quarantine:sweep",
+                      detail: str = "", events: list | None = None):
+    """The ``quarantine`` rung: per-LANE demotion, below the per-chunk
+    rungs of :func:`run_chunk_with_ladder`.
+
+    A lane whose device results come back non-finite while flagged
+    converged (a NaN-poisoned dispatch -- the one corruption the bool
+    success flag cannot witness) is demoted to failed so the rescue
+    ladder re-solves it and every downstream reduction ignores it. The
+    event shape matches the chunk rungs' (`label`/`rung`/`detail`), so
+    journaled runs fold quarantines into the same structured report,
+    and the chunked runner marks affected chunks non-complete so a
+    resume re-solves them. Returns the event dict."""
+    lanes = [int(i) for i in lanes]
+    ev = {"label": label, "rung": "quarantine",
+          "detail": detail or f"{len(lanes)} non-finite converged-flagged "
+                              f"lane(s) demoted: {lanes[:16]}"
+                              f"{'...' if len(lanes) > 16 else ''}",
+          "lanes": lanes}
+    if events is not None:
+        events.append(ev)
+    profiling.record_event("degradation", **ev)
+    print(f"degradation[{label}]: quarantine: {ev['detail']}",
+          file=sys.stderr, flush=True)
+    return ev
+
+
 def _alternate_device(exclude=None):
     """A device different from ``exclude`` (or from the default
     device), or None when the topology has only one."""

@@ -43,12 +43,26 @@ class SteadyStateResults(NamedTuple):
     residual: max |dy/dt| over dynamic entries at the solution.
     iterations: total PTC iterations spent.
     attempts: retries consumed.
+
+    The trailing per-lane diagnostic fields break the overall verdict
+    into its three tests (:func:`_verdict_tests`) at the RETURNED
+    iterate and expose the pseudo-time state the final attempt exited
+    with -- `dt_exit` is the PTC pseudo-step (or the LM damping
+    parameter under the 'lm' strategy): a tiny exit dt on a failed lane
+    means the march was still fighting rejections, a huge one means it
+    reached the Newton regime and stalled elsewhere. They default to
+    None so pre-existing 5-field constructions keep working; the solver
+    always fills them.
     """
     x: jnp.ndarray
     success: jnp.ndarray
     residual: jnp.ndarray
     iterations: jnp.ndarray
     attempts: jnp.ndarray
+    rate_ok: jnp.ndarray | None = None
+    pos_ok: jnp.ndarray | None = None
+    sums_ok: jnp.ndarray | None = None
+    dt_exit: jnp.ndarray | None = None
 
 
 class SolverOptions(NamedTuple):
@@ -177,7 +191,8 @@ def conservation_constraints(groups_dyn):
 
 
 def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
-    """One PTC run from x0; returns (x, normalized_residual, steps).
+    """One PTC run from x0; returns (x, normalized_residual, steps,
+    dt_at_exit).
 
     ``fscale_fn(x) -> (F, gross)`` returns the residual and the gross
     flux scale in one evaluation; both are carried between iterations so
@@ -261,7 +276,7 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     # With chord steps the carried fnorm is already measured against the
     # accepted iterate's own gross scale (see the body), so no post-loop
     # re-measure is needed and loop exit == verdict yardstick.
-    return x, fnorm, k
+    return x, fnorm, k, dt
 
 
 def _verdict_tests(x, fnorm, groups_dyn, opts: SolverOptions):
@@ -306,7 +321,9 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     bounds [0,1]). Where PTC marches pseudo-time, this descends
     ||F/scale||^2 directly, which escapes regions where the pseudo-time
     march cycles. Same projection (clamp + group renormalization) keeps
-    iterates physical. Returns (x, normalized_residual, steps)."""
+    iterates physical. Returns (x, normalized_residual, steps,
+    lam_at_exit) -- lam plays the dt_exit diagnostic role (damping at
+    exit), so both strategies share one result layout."""
     n = x0.shape[0]
     eye = jnp.eye(n, dtype=x0.dtype)
     R, M = conservation_constraints(groups_dyn)
@@ -372,7 +389,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     # whenever GN steps actually fail.
     x, F, gross, fnorm, lam, k = jax.lax.while_loop(
         cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-10, x0.dtype), 0))
-    return x, fnorm, k
+    return x, fnorm, k, lam
 
 
 def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
@@ -392,7 +409,12 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     branch would execute BOTH solvers for every lane; callers instead
     re-run failed lanes with 'lm' in a second pass (the reference's own
     sequential strategy fallback).
-    Returns (x, success, normalized_residual, iterations, attempts).
+    Returns (x, success, normalized_residual, iterations, attempts,
+    rate_ok, pos_ok, sums_ok, dt_exit) -- the trailing four are the
+    per-lane forensic diagnostics of :class:`SteadyStateResults`:
+    the verdict broken into its three tests at the returned iterate,
+    plus the pseudo-step (PTC) or damping (LM) the final attempt
+    exited with.
     """
     attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
     if opts.max_attempts == 1:
@@ -406,23 +428,29 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
         # lexicographic scoreboard degenerates to best-of {x0, x1}.
         F0, gross0 = fscale_fn(x0)
         f0 = _rnorm(F0, gross0, opts)
-        x1, f1, k = attempt_fn(fscale_fn, jac_fn, x0, groups_dyn, opts)
+        x1, f1, k, dt_exit = attempt_fn(fscale_fn, jac_fn, x0,
+                                        groups_dyn, opts)
         ok = _verdict(x1, f1, groups_dyn, opts)
         better = _score(x1, f1, groups_dyn, opts) > _score(x0, f0,
                                                           groups_dyn,
                                                           opts)
         x_out = jnp.where(ok | better, x1, x0)
         f_out = jnp.where(ok | better, f1, f0)
-        return x_out, ok, f_out, k, jnp.asarray(1)
+        rate_ok, pos_ok, sums_ok = _verdict_tests(x_out, f_out,
+                                                  groups_dyn, opts)
+        return (x_out, ok, f_out, k, jnp.asarray(1),
+                rate_ok, pos_ok, sums_ok, dt_exit)
     if key is None:
         key = jax.random.PRNGKey(0)
 
     def attempt_cond(state):
-        x, best_x, best_f, best_s, success, iters, attempt, key = state
+        (x, best_x, best_f, best_s, success, iters, attempt, dt_exit,
+         key) = state
         return (attempt < opts.max_attempts) & (~success)
 
     def attempt_body(state):
-        x, best_x, best_f, best_s, success, iters, attempt, key = state
+        (x, best_x, best_f, best_s, success, iters, attempt, dt_exit,
+         key) = state
         # Attempt 0 trusts the caller's guess verbatim: even a 1e-9
         # renormalization perturbs residuals by k_max * 1e-9, and
         # restarts risk hopping to a different steady-state branch.
@@ -434,8 +462,8 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                           groups_dyn, opts.floor)
         x_start = jnp.where(attempt == 0, x,
                             jnp.where(attempt == 1, x_norm, rand))
-        x_new, fnorm, k = attempt_fn(fscale_fn, jac_fn, x_start,
-                                     groups_dyn, opts)
+        x_new, fnorm, k, dt_new = attempt_fn(fscale_fn, jac_fn, x_start,
+                                             groups_dyn, opts)
         ok = _verdict(x_new, fnorm, groups_dyn, opts)
         # Lexicographic scoreboard across attempts (reference
         # compare_scores): tests passed first, residual second.
@@ -445,18 +473,22 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
         best_f = jnp.where(better, fnorm, best_f)
         best_s = jnp.where(better, s_new, best_s)
         return (x_new, best_x, best_f, best_s, ok, iters + k,
-                attempt + 1, key)
+                attempt + 1, dt_new, key)
 
     F0, gross0 = fscale_fn(x0)
     f0 = _rnorm(F0, gross0, opts)
     s0 = _score(x0, f0, groups_dyn, opts)
-    init = (x0, x0, f0, s0, jnp.asarray(False), 0, 0, key)
-    (x, best_x, best_f, best_s, success, iters, attempts,
+    init = (x0, x0, f0, s0, jnp.asarray(False), 0, 0,
+            jnp.asarray(opts.dt0, x0.dtype), key)
+    (x, best_x, best_f, best_s, success, iters, attempts, dt_exit,
      _) = jax.lax.while_loop(attempt_cond, attempt_body, init)
     x_out = jnp.where(success, x, best_x)
     Fx, grossx = fscale_fn(x)
     f_out = jnp.where(success, _rnorm(Fx, grossx, opts), best_f)
-    return x_out, success, f_out, iters, attempts
+    rate_ok, pos_ok, sums_ok = _verdict_tests(x_out, f_out, groups_dyn,
+                                              opts)
+    return (x_out, success, f_out, iters, attempts,
+            rate_ok, pos_ok, sums_ok, dt_exit)
 
 
 def deflation_basis(groups_dyn) -> "np.ndarray":

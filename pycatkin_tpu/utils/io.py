@@ -284,12 +284,52 @@ def load_results(path: str) -> dict:
         return {k: data[k] for k in data.files}
 
 
+def _truncate_torn_tail(path: str) -> None:
+    """Repair a ``.jsonl`` file whose FINAL line was torn by a kill
+    mid-append: if the file does not end in a newline, truncate back to
+    the byte after the last ``\\n`` (or to empty when no newline
+    exists). Complete records are never touched; this keeps a
+    subsequent append from gluing a new record onto the torn fragment
+    and producing a corrupt NON-final line that
+    :func:`read_json_lines` refuses."""
+    import os
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size == 0:
+        return
+    with open(path, "rb+") as fh:
+        fh.seek(-1, os.SEEK_END)
+        if fh.read(1) == b"\n":
+            return
+        # Scan backwards in chunks for the last newline.
+        pos = size
+        chunk = 4096
+        keep = 0
+        while pos > 0:
+            step = min(chunk, pos)
+            fh.seek(pos - step)
+            buf = fh.read(step)
+            nl = buf.rfind(b"\n")
+            if nl >= 0:
+                keep = pos - step + nl + 1
+                break
+            pos -= step
+        fh.truncate(keep)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
 def append_json_line(path: str, record: dict) -> None:
     """Durably append one JSON object as a line to a ``.jsonl`` file
     (the sweep journal's manifest format, robustness/journal.py): the
     line is flushed AND fsynced before returning, so a record that
-    this function reported written survives a process kill."""
+    this function reported written survives a process kill. A torn
+    final line left by a previous kill is truncated away first, so
+    appending after a crash never corrupts the file."""
     import os
+    _truncate_torn_tail(path)
     line = json.dumps(record, sort_keys=True)
     with open(path, "a") as fh:
         fh.write(line + "\n")

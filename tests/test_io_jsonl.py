@@ -1,0 +1,69 @@
+"""Durable JSONL helpers (utils/io.py): the sweep journal's manifest
+primitives, exercised directly -- truncated-final-line recovery and
+append-after-truncation repair (a kill mid-append must never be able
+to corrupt the file for later appends)."""
+
+import json
+
+import pytest
+
+from pycatkin_tpu.utils.io import append_json_line, read_json_lines
+
+pytestmark = pytest.mark.validate
+
+
+def test_append_read_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    records = [{"i": 0, "s": "a"}, {"i": 1, "nested": {"x": [1, 2]}}]
+    for rec in records:
+        append_json_line(path, rec)
+    assert read_json_lines(path) == records
+
+
+def test_truncated_final_line_dropped(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    append_json_line(path, {"i": 0})
+    append_json_line(path, {"i": 1})
+    with open(path, "a") as fh:
+        fh.write('{"i": 2, "tr')       # kill mid-append: no newline
+    assert read_json_lines(path) == [{"i": 0}, {"i": 1}]
+
+
+def test_corrupt_nonfinal_line_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"i": 0}\nnot json\n{"i": 2}\n')
+    with pytest.raises(json.JSONDecodeError):
+        read_json_lines(path)
+
+
+def test_append_after_truncation_repairs_tail(tmp_path):
+    """Appending over a torn final line truncates the fragment first;
+    gluing the new record onto it would leave a corrupt NON-final line
+    that read_json_lines refuses."""
+    path = str(tmp_path / "j.jsonl")
+    append_json_line(path, {"i": 0})
+    with open(path, "a") as fh:
+        fh.write('{"i": 1, "tr')
+    append_json_line(path, {"i": 2})
+    assert read_json_lines(path) == [{"i": 0}, {"i": 2}]
+
+
+def test_append_after_truncation_empty_file(tmp_path):
+    """A file that is ONLY a torn fragment (kill during the very first
+    append) truncates to empty and the append succeeds."""
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as fh:
+        fh.write('{"to')
+    append_json_line(path, {"i": 0})
+    assert read_json_lines(path) == [{"i": 0}]
+
+
+def test_append_after_long_torn_line(tmp_path):
+    """Torn fragment longer than one backwards-scan chunk (4096 B)."""
+    path = str(tmp_path / "j.jsonl")
+    append_json_line(path, {"i": 0})
+    with open(path, "a") as fh:
+        fh.write('{"blob": "' + "x" * 10000)
+    append_json_line(path, {"i": 1})
+    assert read_json_lines(path) == [{"i": 0}, {"i": 1}]

@@ -1,0 +1,60 @@
+"""tools/lint_fault_sites.py: every fault-site label must be
+documented in docs/failure_model.md -- run the real check as tier-1
+plus unit checks of the AST collection/normalization."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.validate
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import lint_fault_sites  # noqa: E402
+
+
+def test_repo_fault_sites_all_documented():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "lint_fault_sites.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all documented" in proc.stdout
+
+
+def test_normalize_collapses_fstring_fields(tmp_path):
+    src = (
+        "def f(strategy, b, extra):\n"
+        "    call_with_backend_retry(run,\n"
+        "        label=f'rescue[{strategy}{extra}] @{b}')\n"
+        "    timed_retry(run, f'polish @{b}')\n"
+        "    timed_retry(run, 'fast pass')\n"
+        "    site = f'chunk:{b}'\n"
+        "    ax.plot(x, y, label='legend text')\n"      # not a fault site
+        "    record_event('degradation', label=name)\n"  # dynamic: skip
+    )
+    path = tmp_path / "mod.py"
+    path.write_text(src)
+    found = lint_fault_sites.collect_sites(str(tmp_path))
+    labels = sorted(label for label, _, _ in found)
+    assert labels == ["chunk:<i>", "fast pass", "polish @<i>",
+                      "rescue[<i>] @<i>"]
+
+
+def test_missing_label_fails(tmp_path, monkeypatch, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "call_with_backend_retry(run, label='undocumented site')\n")
+    doc = tmp_path / "doc.md"
+    doc.write_text("This doc mentions `some other site` only.\n")
+    monkeypatch.setattr(lint_fault_sites, "PACKAGE", str(pkg))
+    monkeypatch.setattr(lint_fault_sites, "DOC", str(doc))
+    assert lint_fault_sites.main() == 1
+    out = capsys.readouterr().out
+    assert "undocumented site" in out
+    doc.write_text("Now documented: `undocumented site`.\n")
+    assert lint_fault_sites.main() == 0

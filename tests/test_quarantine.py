@@ -1,0 +1,150 @@
+"""Per-lane NaN quarantine (parallel/batch.py) + forensics
+(robustness/forensics.py): a NaN fault in one lane of a steady sweep
+is detected (success=True + non-finite state is the silent-poisoning
+signature), demoted, rescued, and -- the acceptance bar -- leaves
+every OTHER lane's results bit-identical to a clean run. Forensics
+name the quarantined lane with its verdict breakdown and ladder
+history.
+
+CPU-only determinism drill (markers: validate + faults).
+"""
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.robustness import (FaultPlan, FaultSpec, fault_scope,
+                                     format_failure_report,
+                                     sweep_failure_report)
+from pycatkin_tpu.utils import profiling
+
+pytestmark = [pytest.mark.validate, pytest.mark.faults]
+
+N_LANES = 64
+BAD_LANE = 17
+
+
+@pytest.fixture(scope="module")
+def sweep_problem():
+    sim = synthetic_system(n_species=16, n_reactions=24, seed=3)
+    spec = sim.spec
+    conds = broadcast_conditions(sim.conditions(), N_LANES)
+    conds = conds._replace(T=np.linspace(480.0, 620.0, N_LANES))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    opts = sim.solver_options()
+    return spec, conds, mask, opts
+
+
+def _run(spec, conds, mask, opts):
+    return sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                              check_stability=True)
+
+
+def test_nan_lane_quarantined_others_bit_identical(sweep_problem):
+    spec, conds, mask, opts = sweep_problem
+    clean = _run(spec, conds, mask, opts)
+    assert bool(np.all(np.asarray(clean["success"]))), \
+        "drill needs a fully converging clean sweep"
+    assert not np.any(np.asarray(clean.get("quarantined", False)))
+
+    profiling.drain_events()
+    plan = FaultPlan([FaultSpec(site="batched steady solve",
+                                kind="nan", lanes=(BAD_LANE,),
+                                times=1)])
+    with fault_scope(plan):
+        out = _run(spec, conds, mask, opts)
+    events = profiling.drain_events()
+
+    # The poisoned lane was caught: flagged quarantined, then re-solved
+    # by the rescue ladder (un-poisoned dispatch -> converges again).
+    quar = np.asarray(out["quarantined"])
+    assert bool(quar[BAD_LANE])
+    assert [int(i) for i in np.flatnonzero(quar)] == [BAD_LANE]
+
+    # THE acceptance bar: all other lanes bit-identical to a clean run.
+    others = np.arange(N_LANES) != BAD_LANE
+    for key in ("y", "tof", "activity", "success", "stable",
+                "residual"):
+        a = np.asarray(clean[key])[others]
+        b = np.asarray(out[key])[others]
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"lane bleed-through in {key!r}")
+
+    # Quarantine rung event names the lane.
+    qevents = [ev for ev in events
+               if ev.get("kind") == "degradation"
+               and ev.get("rung") == "quarantine"]
+    assert qevents and any(BAD_LANE in ev.get("lanes", [])
+                           for ev in qevents)
+
+    # Forensics: the report names the quarantined lane, its verdict
+    # breakdown and its ladder history.
+    rep = sweep_failure_report(out, conds=conds, events=qevents)
+    assert rep["n_lanes"] == N_LANES
+    assert rep["quarantined_lanes"] == [BAD_LANE]
+    lane = next(r for r in rep["lanes"] if r["lane"] == BAD_LANE)
+    assert lane["quarantined"]
+    assert set(lane["verdict"]) == {"rate_ok", "pos_ok", "sums_ok"}
+    assert lane["history"], "lane history must carry the quarantine event"
+    assert "residual" in lane and "dt_exit" in lane
+    assert "T" in lane["conditions"]
+    text = format_failure_report(rep)
+    assert f"lane {BAD_LANE}:" in text and "QUARANTINED" in text
+
+
+def test_chunked_quarantine_status_forces_resume(sweep_problem,
+                                                 tmp_path):
+    """A chunk whose quarantined lanes stay failed (rescues poisoned
+    too) is journaled with status 'quarantined' -- NOT a completed
+    status, so a resume re-solves it and converges everything."""
+    from pycatkin_tpu.robustness import chunked_sweep_steady_state
+    from pycatkin_tpu.robustness.ladder import DegradationPolicy
+
+    spec, conds, mask, opts = sweep_problem
+    jdir = str(tmp_path / "journal")
+    policy = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002)
+    plan = FaultPlan([
+        FaultSpec(site="batched steady solve", kind="nan",
+                  lanes=(5,), times=None),
+        # fnmatch: [..] is a character class, so "rescue*" (not
+        # "rescue[*]") matches the rescue[ptc]/rescue[lm] sites.
+        FaultSpec(site="rescue*", kind="nan", times=None),
+    ])
+    with fault_scope(plan):
+        out, report = chunked_sweep_steady_state(
+            spec, conds, chunk=32, tof_mask=mask, opts=opts,
+            journal=jdir, policy=policy)
+    assert report["quarantined"], "no chunk recorded as quarantined"
+    quar = np.asarray(out["quarantined"])
+    succ = np.asarray(out["success"])
+    assert np.any(quar & ~succ)
+    qevents = [ev for ev in report["events"]
+               if ev.get("rung") == "quarantine"]
+    assert qevents and all(ev["lanes"] for ev in qevents)
+
+    # Resume with the faults gone: quarantined chunks re-dispatch.
+    out2, report2 = chunked_sweep_steady_state(
+        spec, conds, chunk=32, tof_mask=mask, opts=opts,
+        journal=jdir, resume=True, policy=policy)
+    assert sorted(report2["reused"]) == sorted(
+        set(range(report["n_chunks"])) - set(report["quarantined"]))
+    assert bool(np.all(np.asarray(out2["success"])))
+    assert not np.any(np.asarray(out2["quarantined"])
+                      & ~np.asarray(out2["success"]))
+
+
+def test_lane_diagnostics_present_on_clean_sweep(sweep_problem):
+    """The per-lane solver diagnostics ride in every sweep result (the
+    forensics layer must not need a special mode to have data)."""
+    spec, conds, mask, opts = sweep_problem
+    out = _run(spec, conds, mask, opts)
+    for key in ("rate_ok", "pos_ok", "sums_ok"):
+        arr = np.asarray(out[key])
+        assert arr.shape == (N_LANES,) and arr.dtype == bool
+        assert bool(np.all(arr))        # converged clean sweep
+    dt = np.asarray(out["dt_exit"])
+    assert dt.shape == (N_LANES,) and np.all(np.isfinite(dt))
+    assert not np.any(np.asarray(out["quarantined"]))

@@ -1,0 +1,196 @@
+"""Input-validation gate (frontend/validate.py): report structure,
+strict/warn/off semantics, loader wiring and contextual loader errors.
+
+Marker ``validate`` (in the default `not slow` selection; run alone
+with `make test-validate`).
+"""
+
+import json
+
+import pytest
+
+import pycatkin_tpu as pk
+from pycatkin_tpu.api.system import System
+from pycatkin_tpu.frontend.reactions import UserDefinedReaction
+from pycatkin_tpu.frontend.states import State
+from pycatkin_tpu.frontend.validate import (ValidationError,
+                                            validate_system,
+                                            validation_mode)
+from pycatkin_tpu.models.reactor import InfiniteDilutionReactor
+
+pytestmark = pytest.mark.validate
+
+
+def _bad_site_balance_system():
+    """s* -> 2 sA*: occupies 1 surface site on the left, 2 on the
+    right."""
+    s = State(name="s", state_type="surface")
+    sa = State(name="sa", state_type="adsorbate")
+    rx = UserDefinedReaction(name="bad", reac_type="arrhenius",
+                             reactants=[s], products=[sa, sa],
+                             dGrxn_user=-0.4, dGa_fwd_user=0.7)
+    sim = System(start_state={"s": 1.0}, T=500.0, p=1.0e5)
+    sim.add_state(s)
+    sim.add_state(sa)
+    sim.add_reaction(rx)
+    sim.add_reactor(InfiniteDilutionReactor())
+    return sim
+
+
+def _gas(name, mass):
+    return State(name=name, state_type="gas", sigma=1, mass=mass)
+
+
+def test_report_names_exact_reaction():
+    report = validate_system(_bad_site_balance_system())
+    assert not report.ok
+    locs = [i.location for i in report.errors]
+    assert "/reactions/bad" in locs
+    msg = str(report)
+    assert "surface-site imbalance" in msg and "'sa'" in msg
+
+
+def test_build_strict_raises_with_report():
+    sim = _bad_site_balance_system()
+    with pytest.raises(ValidationError) as ei:
+        sim.build(strict=True)
+    assert "/reactions/bad" in str(ei.value)
+    assert not ei.value.report.ok
+
+
+def test_mass_imbalance_error():
+    a, b = _gas("A", 28.0), _gas("B", 16.0)
+    rx = UserDefinedReaction(name="iso", reac_type="arrhenius",
+                             reactants=[a], products=[b],
+                             dGrxn_user=0.1, dGa_fwd_user=0.5)
+    sim = System(start_state={"s": 1.0}, T=500.0, p=1.0e5)
+    sim.add_state(State(name="s", state_type="surface"))
+    sim.add_state(a)
+    sim.add_state(b)
+    sim.add_reaction(rx)
+    sim.add_reactor(InfiniteDilutionReactor())
+    report = validate_system(sim)
+    assert any(i.location == "/reactions/iso"
+               and "mass imbalance" in i.message for i in report.errors)
+
+
+def test_nonfinite_energy_error_names_state():
+    sim = _bad_site_balance_system()
+    sim.add_state(State(name="x", state_type="adsorbate",
+                        freq=[1.0e13], Gelec=float("nan")))
+    report = validate_system(sim)
+    assert any(i.location == "/states/x/Gelec"
+               and "non-finite" in i.message for i in report.errors)
+
+
+def test_warn_mode_warns_instead_of_raising():
+    report = validate_system(_bad_site_balance_system())
+    with pytest.warns(UserWarning, match="/reactions/bad"):
+        report.emit("warn")
+
+
+def test_off_mode_is_silent(recwarn):
+    report = validate_system(_bad_site_balance_system())
+    report.emit("off")
+    assert not [w for w in recwarn
+                if issubclass(w.category, UserWarning)]
+
+
+def test_validation_mode_env(monkeypatch):
+    monkeypatch.delenv("PYCATKIN_VALIDATE", raising=False)
+    assert validation_mode() == "warn"
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "STRICT")
+    assert validation_mode() == "strict"
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "sometimes")
+    with pytest.raises(ValueError, match="PYCATKIN_VALIDATE"):
+        validation_mode()
+
+
+def test_build_env_override(monkeypatch):
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "strict")
+    with pytest.raises(ValidationError):
+        _bad_site_balance_system().build()
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "off")
+    _bad_site_balance_system().build()    # gate skipped
+
+
+# ---- loader wiring + contextual error messages -----------------------
+
+_VALID_INPUT = {
+    "states": {
+        "s": {"state_type": "surface"},
+        "sA": {"state_type": "adsorbate", "freq": [1.0e13]},
+        "A": {"state_type": "gas", "sigma": 1, "mass": 28.0,
+              "Gelec": 0.0},
+    },
+    "system": {"p": 1.0e5, "T": 500.0, "times": [0.0, 1.0],
+               "start_state": {"s": 1.0}},
+    "manual reactions": {
+        "ads": {"reac_type": "adsorption", "area": 1.0e-19,
+                "reactants": ["A", "s"], "products": ["sA"],
+                "dGrxn_user": -0.5, "dGa_fwd_user": 0.1},
+    },
+    "reactor": "InfiniteDilutionReactor",
+}
+
+
+def _write_input(tmp_path, cfg):
+    path = str(tmp_path / "input.json")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(cfg))
+    return path
+
+
+def test_loader_valid_input_loads(tmp_path):
+    sim = pk.read_from_input_file(_write_input(tmp_path, _VALID_INPUT))
+    assert set(sim.reactions) == {"ads"}
+
+
+def test_loader_unknown_state_names_file_and_key(tmp_path):
+    cfg = json.loads(json.dumps(_VALID_INPUT))
+    cfg["manual reactions"]["ads"]["products"] = ["sB"]
+    path = _write_input(tmp_path, cfg)
+    with pytest.raises(KeyError) as ei:
+        pk.read_from_input_file(path)
+    msg = str(ei.value)
+    assert path in msg
+    assert "/manual reactions/ads/products" in msg and "'sB'" in msg
+
+
+def test_loader_missing_pressure_names_key(tmp_path):
+    cfg = json.loads(json.dumps(_VALID_INPUT))
+    del cfg["system"]["p"]
+    path = _write_input(tmp_path, cfg)
+    with pytest.raises(KeyError, match="/system/p"):
+        pk.read_from_input_file(path)
+
+
+def test_loader_nongas_inflow_names_state(tmp_path):
+    cfg = json.loads(json.dumps(_VALID_INPUT))
+    cfg["system"]["inflow_state"] = {"sA": 1.0}
+    path = _write_input(tmp_path, cfg)
+    with pytest.raises(TypeError,
+                       match="/system/inflow_state/sA"):
+        pk.read_from_input_file(path)
+
+
+def test_loader_nan_energy_strict_vs_warn(tmp_path, monkeypatch):
+    # python's json parser accepts the NaN literal a crashed writer
+    # can leave behind.
+    cfg = json.loads(json.dumps(_VALID_INPUT))
+    path = _write_input(tmp_path, cfg)
+    with open(path) as fh:
+        text = fh.read().replace('"Gelec": 0.0', '"Gelec": NaN')
+    with open(path, "w") as fh:
+        fh.write(text)
+
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "strict")
+    with pytest.raises(ValidationError) as ei:
+        pk.read_from_input_file(path)
+    assert "/states/A/Gelec" in str(ei.value)
+    assert path in str(ei.value)
+
+    monkeypatch.setenv("PYCATKIN_VALIDATE", "warn")
+    with pytest.warns(UserWarning, match="/states/A/Gelec"):
+        sim = pk.read_from_input_file(path)
+    assert set(sim.reactions) == {"ads"}

@@ -397,7 +397,8 @@ def test_lm_attempt_converges_on_volcano(ref_root):
     x0 = jnp.full((n,), 1.0 / n)
 
     opts = newton.SolverOptions()
-    x_lm, f_lm, _ = newton._lm_attempt(fscale, jac, x0, groups_dyn, opts)
+    x_lm, f_lm, _, _ = newton._lm_attempt(fscale, jac, x0, groups_dyn,
+                                          opts)
     assert float(f_lm) <= 1.0, "LM did not converge"
 
     res = engine.steady_state(spec, cond)
