@@ -3,12 +3,17 @@
 # journal-resume tests and the validate lane the input-validation-gate
 # / quarantine tests (both markers stay inside the default `not slow`
 # selection). `lint-faults` statically checks that every fault-site
-# label in pycatkin_tpu/ is documented in docs/failure_model.md.
+# label in pycatkin_tpu/ is documented in docs/failure_model.md;
+# `lint-syncs` that the sweep hot path has no uncounted host
+# materializations (docs/index.md "Performance"). `bench-smoke` is the
+# end-to-end canary: an 8x8 CPU sweep with prewarm that fails on any
+# crash or on a clean sweep exceeding the host-sync budget.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test test-faults test-validate test-all lint-faults
+.PHONY: test test-faults test-validate test-all lint-faults lint-syncs \
+	bench-smoke
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -24,3 +29,9 @@ test-all:
 
 lint-faults:
 	python tools/lint_fault_sites.py
+
+lint-syncs:
+	python tools/lint_host_syncs.py
+
+bench-smoke:
+	env JAX_PLATFORMS=cpu python bench.py --smoke

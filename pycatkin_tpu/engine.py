@@ -36,6 +36,7 @@ from .solvers.newton import SolverOptions, SteadyStateResults
 from .solvers.ode import (ODEOptions, init_state as ode_init_state,
                           integrate, integrate_state as ode_integrate_state,
                           log_time_grid)
+from .utils.profiling import host_sync
 
 eVtoJmol = eVtokJ * 1.0e3
 
@@ -441,7 +442,7 @@ def chunked_transient_drive(step, finish, conds, y0, save_ts,
         if npad:
             part = np.concatenate([part, np.full(npad, ts[-1])])
         state, ys_chunk = step(conds, state, jnp.asarray(part))
-        ys_np = np.asarray(ys_chunk)
+        ys_np = host_sync(ys_chunk, f"transient chunk[{i // chunk}]")
         if npad:
             ys_np = ys_np[:, :chunk - npad] if batched else \
                 ys_np[:chunk - npad]
@@ -450,9 +451,9 @@ def chunked_transient_drive(step, finish, conds, y0, save_ts,
     last = ys[:, -1] if batched else ys[-1]
     y_fin, ok = finish(conds, jnp.asarray(last), state[3])
     if batched:
-        ys[:, -1] = np.asarray(y_fin)
+        ys[:, -1] = host_sync(y_fin, "transient finish")
     else:
-        ys[-1] = np.asarray(y_fin)
+        ys[-1] = host_sync(y_fin, "transient finish")
     return jnp.asarray(ys), ok
 
 

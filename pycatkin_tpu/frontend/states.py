@@ -38,6 +38,10 @@ STATE_TYPES = (GAS, ADSORBATE, SURFACE, TS)
 # when detecting linear molecules (reference state.py:69,99).
 INERTIA_CUTOFF = 1.0e-12
 
+# State names already warned about degenerate inertia tensors (the
+# warning fires once per process per state, not once per rebuild).
+_ZERO_INERTIA_WARNED: set = set()
+
 # CPK/jmol-ish element colors + covalent-radius-ish sizes for the
 # headless structure render (State.save_png). Unlisted elements fall
 # back to gray / 1.2 A.
@@ -159,7 +163,12 @@ class State:
         inertia = np.where(inertia > INERTIA_CUTOFF, inertia, 0.0)
         self.inertia = inertia
         self.shape = int((inertia > 0.0).sum())
-        if self.state_type == GAS and self.shape < 2:
+        if (self.state_type == GAS and self.shape < 2
+                and self.name not in _ZERO_INERTIA_WARNED):
+            # Warn once per process per state: every rebuild/sweep setup
+            # re-derives the same inertia tensor, and repeating the
+            # warning per rebuild buries real diagnostics in the log.
+            _ZERO_INERTIA_WARNED.add(self.name)
             print(f"state {self.name}: too many zero moments of inertia",
                   file=sys.stderr)
 

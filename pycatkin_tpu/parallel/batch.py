@@ -28,7 +28,9 @@ from .. import engine
 from ..frontend.spec import Conditions, ModelSpec
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
+from ..utils.profiling import host_sync, record_event
 from ..utils.retry import call_with_backend_retry
+from . import compile_pool
 
 
 # ---------------------------------------------------------------------
@@ -44,14 +46,54 @@ from ..utils.retry import call_with_backend_retry
 # UQ copy, loops over mechanisms) release device memory explicitly.
 def clear_program_caches():
     """Drop all cached jitted programs (and their spec references),
-    including the engine-level transient chunk/finish programs."""
+    including the engine-level transient chunk/finish programs and the
+    AOT executable registry (compile_pool)."""
     _steady_program.cache_clear()
     _transient_chunk_program.cache_clear()
     _transient_finish_program.cache_clear()
     _tof_program.cache_clear()
     _jacobian_program.cache_clear()
+    _stability_screen_program.cache_clear()
     engine._transient_chunk_program.cache_clear()
     engine._transient_finish_program.cache_clear()
+    compile_pool.clear_registry()
+
+
+# ---------------------------------------------------------------------
+# AOT executable registry bridge. prewarm_sweep_programs publishes
+# compiled (or disk-loaded) executables in compile_pool's registry; the
+# hot path consults it before the ordinary jitted program. This is what
+# makes a warm-disk prewarm real: ``f.lower().compile()`` does NOT
+# populate jit's dispatch cache, so without the registry an AOT-loaded
+# executable would never actually run and the first in-band hit would
+# silently re-trace + re-compile.
+def _steady_kind(opts: SolverOptions, strategy: str) -> str:
+    """Registry/cache kind string for a steady-solve program variant;
+    prewarm and the hot path MUST derive it identically (shapes ride in
+    the key separately)."""
+    return f"steady:{strategy}:{opts!r}"
+
+
+def _screen_kind(pos_tol: float, backend: str) -> str:
+    return f"screen:{pos_tol!r}:{backend}"
+
+
+def _registered_call(spec: ModelSpec, kind: str, prog, args):
+    """Run ``prog(*args)`` through a registered AOT executable when one
+    matches (kind + argument shapes), else through the jitted program.
+    A registered executable that refuses the arguments (shape/sharding
+    drift vs what prewarm saw) is evicted and the call falls back --
+    correctness never depends on the registry."""
+    key = compile_pool.program_key(kind, args)
+    exe = compile_pool.lookup(spec, key)
+    if exe is not None:
+        try:
+            return exe(*args)
+        except Exception as e:
+            compile_pool.unregister(spec, key)
+            record_event("degradation", label="aot:fallback",
+                         error=f"{type(e).__name__}: {e}"[:200])
+    return prog(*args)
 
 
 @lru_cache(maxsize=16)
@@ -171,10 +213,11 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     # scalar off this result immediately anyway).
     if mesh is None:
         prog = _steady_program(spec, opts)
+        kind = _steady_kind(opts, "ptc")
 
         def run_solve():
-            out = prog(conds, keys, x0)
-            np.asarray(jnp.sum(out.residual))
+            out = _registered_call(spec, kind, prog, (conds, keys, x0))
+            host_sync(jnp.sum(out.residual), "solve fence")
             return out
 
         return call_with_backend_retry(run_solve,
@@ -192,8 +235,10 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     prog_sh = _steady_program(spec, opts, sharding)
 
     def run_solve_sharded():
+        # The registry is bypassed on the mesh path: serialized
+        # executables bake in shardings prewarm never sees.
         out = prog_sh(conds_p, keys_p, x0_p)
-        np.asarray(jnp.sum(out.residual))
+        host_sync(jnp.sum(out.residual), "solve fence (sharded)")
         return out
 
     out = call_with_backend_retry(run_solve_sharded,
@@ -347,7 +392,8 @@ def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
 
 def stability_mask(spec: ModelSpec, conds: Conditions, ys,
                    pos_tol: float = 1e-2, ok=None,
-                   backend: Optional[str] = None) -> jnp.ndarray:
+                   backend: Optional[str] = None,
+                   precomputed=None) -> jnp.ndarray:
     """[lanes] Jacobian-eigenvalue stability verdict (reference
     solver.py:102-106) for batched steady solutions, two-tier:
 
@@ -372,7 +418,11 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     eigenvalue solve. ``backend``: platform of the devices the screen
     actually runs on (certificate margins are backend-dependent; the
     caller that owns the mesh passes it -- None reads the default
-    backend at call time). Returns a DEVICE bool array.
+    backend at call time). ``precomputed``: an already-dispatched
+    ``(certified, ambiguous, n_ambiguous)`` triple from the SAME screen
+    program on the SAME ``ys``/``ok`` (the fused sweep tail's
+    speculative screen) -- skips re-running tier 1. Returns a DEVICE
+    bool array.
     """
     from ..solvers.newton import stability_tolerance
     ys = jnp.asarray(ys)
@@ -380,27 +430,37 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
               else jnp.ones(n, dtype=bool))
     backend = _resolve_backend(backend)
-    def run_screen():
-        # Dispatch AND the scalar materialization inside one retried
-        # unit: on the async backend an execution-time transport flake
-        # surfaces at the materialization, so retrying only the
-        # dispatch would not re-run the program.
-        cert, amb, n_amb_dev = _stability_screen_program(
-            spec, pos_tol, backend)(conds, ys, ok_dev)
-        return cert, amb, int(np.asarray(n_amb_dev))  # scalar round trip
+    if precomputed is not None:
+        certified, ambiguous, n_amb = precomputed
+        n_amb = int(n_amb)
+    else:
+        def run_screen():
+            # Dispatch AND the scalar materialization inside one
+            # retried unit: on the async backend an execution-time
+            # transport flake surfaces at the materialization, so
+            # retrying only the dispatch would not re-run the program.
+            cert, amb, n_amb_dev = _registered_call(
+                spec, _screen_kind(pos_tol, backend),
+                _stability_screen_program(spec, pos_tol, backend),
+                (conds, ys, ok_dev))
+            # scalar round trip
+            return cert, amb, int(host_sync(n_amb_dev,
+                                            "stability screen"))
 
-    certified, ambiguous, n_amb = call_with_backend_retry(
-        run_screen, label="stability screen")
+        certified, ambiguous, n_amb = call_with_backend_retry(
+            run_screen, label="stability screen")
     if n_amb:
-        idx = np.flatnonzero(np.asarray(ambiguous))
+        idx = np.flatnonzero(np.asarray(ambiguous))  # sync-ok: tier-2 failure path
         sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,))
 
         # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
         # never cross the ~11 MB/s tunnel (pow2 padding can nearly
         # double the payload).
         def run_jac():
-            return np.asarray(
-                _jacobian_program(spec)(sub, ys_p)[:len(idx)])
+            return host_sync(
+                _registered_call(spec, "jac", _jacobian_program(spec),
+                                 (sub, ys_p))[:len(idx)],
+                "tier-2 jacobian")
 
         Js = call_with_backend_retry(run_jac,
                                      label="stability tier-2 jacobian")
@@ -508,10 +568,11 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     # full mask crosses to the host only when lanes actually failed
     # (the common volcano case is zero failures -> one cheap scalar).
     if n_failed is None:
-        n_failed = int(np.asarray(jnp.sum(~jnp.asarray(res.success))))
+        n_failed = int(host_sync(jnp.sum(~jnp.asarray(res.success)),
+                                 "rescue pre-check"))
     if n_failed == 0:
         return res, 0
-    success = np.asarray(res.success)
+    success = np.asarray(res.success)  # sync-ok: failure path, full mask needed
     idx = np.flatnonzero(~success)
     sub, idx_p = _padded_subset(conds, idx, bucket=pad_to)
     seed_lane = idx_p
@@ -530,26 +591,35 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     # materialization rides inside the retried unit so execution-time
     # flakes re-dispatch too.
     def run_rescue():
-        o = _steady_program(spec, opts, strategy=strategy)(sub, keys, x0)
-        return o, np.asarray(o.success)[:len(idx)]
+        o = _registered_call(spec, _steady_kind(opts, strategy),
+                             _steady_program(spec, opts,
+                                             strategy=strategy),
+                             (sub, keys, x0))
+        return o, host_sync(o.success,
+                            f"rescue[{strategy}]")[:len(idx)]
 
     out, got = call_with_backend_retry(run_rescue,
                                        label=f"rescue[{strategy}]")
     n_remaining = int(n_failed - got.sum())
+    # Structured evidence of every rescue-pass invocation (bench.py
+    # folds the per-trial counts into its report; no sync -- a host
+    # list append on already-materialized ints).
+    record_event("rescue", label=f"rescue[{strategy}]",
+                 n_failed=int(n_failed), n_remaining=n_remaining)
     if not got.any():
         return res, n_remaining
-    x = np.array(res.x)
+    x = np.array(res.x)  # sync-ok: failure path, writable host merge copies
     succ = np.array(res.success)
     resid = np.array(res.residual)
     iters = np.array(res.iterations)
     atts = np.array(res.attempts)
-    x[idx[got]] = np.asarray(out.x)[:len(idx)][got]
+    x[idx[got]] = np.asarray(out.x)[:len(idx)][got]  # sync-ok: failure path
     succ[idx[got]] = True
-    resid[idx[got]] = np.asarray(out.residual)[:len(idx)][got]
+    resid[idx[got]] = np.asarray(out.residual)[:len(idx)][got]  # sync-ok: failure path
     # Diagnostics accumulate across passes: the hardest lanes must
     # report their true total cost, not the capped fast-pass numbers.
-    iters[idx] += np.asarray(out.iterations)[:len(idx)]
-    atts[idx] += np.asarray(out.attempts)[:len(idx)]
+    iters[idx] += np.asarray(out.iterations)[:len(idx)]  # sync-ok: failure path
+    atts[idx] += np.asarray(out.attempts)[:len(idx)]  # sync-ok: failure path
     # Forensic fields follow the iterate actually stored: recovered
     # lanes take the rescue attempt's diagnostics; still-failed lanes
     # keep the ones describing the res.x they still carry.
@@ -560,7 +630,7 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
         if cur is None or new is None:
             continue
         arr = np.array(cur)
-        arr[idx[got]] = np.asarray(new)[:len(idx)][got]
+        arr[idx[got]] = np.asarray(new)[:len(idx)][got]  # sync-ok: failure path
         extra[name] = jnp.asarray(arr)
     return res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
                         residual=jnp.asarray(resid),
@@ -605,12 +675,22 @@ def _quarantine_mask(res, quarantined=None):
     them to failed so the rescue ladder re-solves them and no
     downstream reduction trusts their values. Returns ``(res, mask)``
     with ``mask`` ORed into ``quarantined`` when given."""
-    x = jnp.asarray(res.x)
-    finite = (jnp.all(jnp.isfinite(x), axis=-1)
-              & jnp.isfinite(jnp.asarray(res.residual)))
+    from ..solvers.newton import lane_finite_mask
+    finite = lane_finite_mask(res.x, res.residual)
     q_new = jnp.asarray(res.success) & ~finite
     q = q_new if quarantined is None else jnp.asarray(quarantined) | q_new
     return res._replace(success=jnp.asarray(res.success) & finite), q
+
+
+# The cross-lane verdict reductions of one sweep, packed into a single
+# int32 bundle (see solvers.newton.packed_sweep_diagnostics): a clean
+# sweep's tail materializes exactly this one vector. Plain module-level
+# jit: it caches per (shapes, which-optional-args) signature.
+@jax.jit
+def _tail_bundle(success, quarantined, ambiguous, demoted, n_neg):
+    from ..solvers.newton import packed_sweep_diagnostics
+    return packed_sweep_diagnostics(success, quarantined, ambiguous,
+                                    demoted, n_neg)
 
 
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
@@ -619,23 +699,90 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     """Shared sweep tail: quarantine, rescue ladder, stability
     verdict/demote loop, TOF/activity -- everything downstream of the
     first solving pass (used by both sweep_steady_state and
-    continuation_sweep)."""
-    # One scalar round trip decides the whole three-pass rescue ladder
-    # (polish -> full PTC -> LM; the failed count then threads through
-    # as a host int -- each materialization call costs ~0.1-1 s on the
-    # tunneled backend). The quarantine count rides the same transfer.
-    # The seeded passes use converged NEIGHBORS
-    # (continuation):
+    continuation_sweep).
+
+    Sync-lean structure: the quarantine mask, the stability screen, the
+    TOF/activity program and every cross-lane count are dispatched
+    SPECULATIVELY (assuming the common clean sweep) with no per-stage
+    materialization; ONE packed int bundle
+    (:func:`solvers.newton.packed_sweep_diagnostics`) then crosses to
+    the host. A clean sweep assembles its result from the already-
+    computed device arrays -- two blocking host syncs total including
+    the solve fence (enforced by tests/test_sync_budget.py, budget
+    <= 3). Any failure/ambiguity falls back to the exact legacy
+    sequence (rescue ladder, tier-2 eigensolve, demote loop), paying
+    its per-stage syncs only on the failure path, with the speculative
+    screen reused when the ladder did not run (res.x unchanged).
+    """
+    backend = _resolve_backend(backend)
+    res, quar = _quarantine_mask(res)
+    succ0 = jnp.asarray(res.success)
+    mask_arr = jnp.asarray(tof_mask) if tof_mask is not None else None
+
+    def run_tail():
+        # Speculative clean-path tail: every dispatch is async; the
+        # ONE materialization (the packed bundle) rides inside this
+        # retried unit so an execution-time transport flake re-runs
+        # the whole (pure) tail.
+        cert = amb = n_amb_dev = None
+        if check_stability:
+            cert, amb, n_amb_dev = _registered_call(
+                spec, _screen_kind(pos_jac_tol, backend),
+                _stability_screen_program(spec, pos_jac_tol, backend),
+                (conds, res.x, succ0))
+            ok_spec = succ0 & cert
+            demoted = succ0 & ~cert
+        else:
+            ok_spec = succ0
+            demoted = None
+        tofs = act = n_neg_dev = None
+        if tof_mask is not None:
+            tofs, act, n_neg_dev = _registered_call(
+                spec, "tof", _tof_program(spec),
+                (conds, res.x, mask_arr, ok_spec))
+        bundle = _tail_bundle(succ0, quar, amb, demoted, n_neg_dev)
+        return (cert, amb, n_amb_dev, tofs, act,
+                host_sync(bundle, "sweep tail bundle"))
+
+    cert, amb, n_amb_dev, tofs, act, counts = call_with_backend_retry(
+        run_tail, label="sweep tail")
+    nf, nq, n_amb, n_dem, n_neg = (int(c) for c in counts)
+
+    if nf == 0 and (not check_stability
+                    or (n_amb == 0 and n_dem == 0)):
+        # Clean sweep: everything already computed; no further syncs.
+        out = {"y": res.x, "success": res.success,
+               "residual": res.residual, "iterations": res.iterations,
+               "attempts": res.attempts, "quarantined": quar}
+        for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+            v = getattr(res, name, None)
+            if v is not None:
+                out[name] = v
+        if check_stability:
+            out["stable"] = cert
+            out["success"] = jnp.logical_and(jnp.asarray(res.success),
+                                             jnp.asarray(cert))
+        if tof_mask is not None:
+            out["tof"] = tofs
+            out["activity"] = act
+            _warn_negative_tof(n_neg)
+        return out
+
+    # Failure path: the legacy per-stage sequence, bit-for-bit. The
+    # speculative tail already paid for the counts, so the ladder
+    # decision costs no extra round trip; its tof/screen outputs are
+    # reused only where res.x provably did not change.
+    #
+    # Three-pass rescue ladder (polish -> full PTC -> LM; the failed
+    # count threads through as a host int -- each materialization call
+    # costs ~0.1-1 s on the tunneled backend). The seeded passes use
+    # converged NEIGHBORS (continuation):
     # measured on the 256x256 volcano's 269 phase-boundary lanes, the
     # ladder needs max 2 attempts / 216 accumulated iterations with
     # neighbor seeds vs 6 attempts / 1091 iterations from the lanes'
     # own failed iterates -- 5x less union work through the SAME
     # compiled program (the warm wall is latency-bound at this bucket
     # width, ~2 s either way; the headroom pays on harder grids).
-    res, quar = _quarantine_mask(res)
-    counts = np.asarray(jnp.stack(
-        [jnp.sum(quar), jnp.sum(~jnp.asarray(res.success))]))
-    nq, nf = int(counts[0]), int(counts[1])
     nf0 = nf
     if nf > 0:
         # Seeded near-Newton polish first: the cheap pass that
@@ -654,14 +801,22 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         # write fresh non-finite "successes" (fault sites rescue[*]);
         # only the failure path pays this extra scalar round trip.
         res, quar = _quarantine_mask(res, quar)
-        nq = int(np.asarray(jnp.sum(quar)))
+        nq = int(host_sync(jnp.sum(quar), "post-ladder quarantine"))
     if nq > 0:
         from ..robustness.ladder import record_quarantine
-        record_quarantine(np.flatnonzero(np.asarray(quar)).tolist(),
-                          label="quarantine:sweep")
+        record_quarantine(
+            np.flatnonzero(
+                host_sync(quar, "quarantine lanes")).tolist(),
+            label="quarantine:sweep")
     if check_stability:
+        # The speculative screen is exact iff the ladder never ran
+        # (res.x unchanged); the TPU emulated-f64 case -- clean solve,
+        # many ambiguous lanes -- lands here and skips re-running
+        # tier 1 entirely.
+        pre = ((cert, amb, n_amb) if nf0 == 0 else None)
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
-                                ok=res.success, backend=backend)
+                                ok=res.success, backend=backend,
+                                precomputed=pre)
         # Converged-but-UNSTABLE lanes (e.g. the middle root of a
         # bistable mechanism) get the facade's random-restart treatment
         # (api/system.py find_steady: up to 3 retries from fresh
@@ -673,7 +828,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         # round (see stability_mask on materialization-call cost).
         for round_i in range(3):
             demoted = jnp.asarray(res.success) & ~stable
-            if int(np.asarray(jnp.sum(demoted))) == 0:
+            if int(host_sync(jnp.sum(demoted), "demote count")) == 0:
                 break
             res = res._replace(
                 success=jnp.asarray(res.success) & stable)
@@ -696,15 +851,16 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
                                          jnp.asarray(stable))
     if tof_mask is not None:
-        mask_arr = jnp.asarray(tof_mask)
         tprog = _tof_program(spec)
         ok_arr = jnp.asarray(out["success"])
 
         def run_tof():
             # The n_neg materialization doubles as the execution sync
             # inside the retried unit (see batch_steady_state).
-            t, a, nn = tprog(conds, res.x, mask_arr, ok_arr)
-            return t, a, int(np.asarray(nn))
+            t, a, nn = _registered_call(spec, "tof", tprog,
+                                        (conds, res.x, mask_arr,
+                                         ok_arr))
+            return t, a, int(host_sync(nn, "tof sign check"))
 
         tofs, act, n_neg = call_with_backend_retry(run_tof,
                                                    label="tof/activity")
@@ -743,7 +899,7 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     that still fails lands in the ordinary rescue ladder). Returns the
     same dict as :func:`sweep_steady_state`, in original lane order.
     """
-    order = np.asarray(order)
+    order = np.asarray(order)  # sync-ok: host-built index plan, not device data
     n_stages, m = order.shape
     n_lanes = len(jax.tree_util.tree_leaves(conds)[0])
     # A malformed order would silently place solutions on the wrong
@@ -813,6 +969,21 @@ def _fast_pass_opts(opts: SolverOptions) -> SolverOptions:
                          max_attempts=1)
 
 
+class PrewarmStats(int):
+    """:func:`prewarm_sweep_programs` return value: an ``int`` (the
+    program count, backward compatible with every existing caller) that
+    additionally carries the compile/cache breakdown as attributes:
+    ``compiled`` (fresh XLA compiles), ``loaded`` (AOT cache hits),
+    ``cache_writes``, ``executed`` (programs also run once), and
+    ``cache`` (the :class:`compile_pool.AOTCache` stats dict)."""
+
+    compiled: int = 0
+    loaded: int = 0
+    cache_writes: int = 0
+    executed: int = 0
+    cache: dict = {}
+
+
 def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            tof_mask=None,
                            opts: SolverOptions = SolverOptions(),
@@ -822,10 +993,12 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            tier2_aot_buckets=(),
                            check_stability: bool = True,
                            pos_jac_tol: float = 1e-2,
-                           verbose: bool = False):
-    """Compile (or load from the persistent cache) every program
-    :func:`sweep_steady_state` can touch at this lane count, up to
-    rescue/ambiguous subsets of ``max(buckets + aot_buckets)`` lanes.
+                           verbose: bool = False,
+                           cache=None,
+                           workers: int | None = None):
+    """Compile (or load from the on-disk AOT executable cache) every
+    program :func:`sweep_steady_state` can touch at this lane count, up
+    to rescue/ambiguous subsets of ``max(buckets + aot_buckets)`` lanes.
 
     The sweep's hot path compiles lazily: the rescue ladder, the
     x0-free demote re-solve and the stability tier-2 Jacobian all
@@ -837,13 +1010,20 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     program, and per pow2 bucket the PTC/LM rescue (seeded and
     unseeded) plus the subset Jacobian.
 
-    ``buckets`` are compiled AND executed once (the jit dispatch caches
-    are then fully hot -- a later in-band hit is pure execution);
-    ``aot_buckets`` are compiled ahead-of-time only
-    (``.lower().compile()``, no device execution) -- cheaper to warm,
-    and a later in-band hit pays only the trace + persistent-cache
-    executable load, never the full compile. Put the likely failure
-    scales in ``buckets`` and the insurance scales in ``aot_buckets``.
+    Pipelined execution (vs the r05 sequential loop, 136.6 s for 32
+    programs): every ``.lower().compile()`` not satisfied by the AOT
+    cache is submitted to a bounded thread pool
+    (:func:`compile_pool.map_compile`; XLA compiles release the GIL),
+    the resulting executables are serialized into the cache
+    (:class:`compile_pool.AOTCache` -- a restarted process deserializes
+    instead of compiling) and published in the process-wide registry
+    that the sweep hot path consults, so warmed programs are what a
+    sweep actually runs.
+
+    ``buckets`` are compiled AND executed once (runtime paging and
+    dispatch paths then fully hot); ``aot_buckets`` are compiled/loaded
+    only -- cheaper to warm; a later in-band hit executes the
+    registered AOT executable with no trace or compile.
     ``tier2_buckets`` warm (execute) ONLY the subset-Jacobian program
     at additional shapes -- the stability tier-2's ambiguous subset
     follows a different count distribution than the rescue's failed
@@ -852,12 +1032,17 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     <~1 % of volcano lanes on true-f64 CPU but ~14 % on the emulated-
     f64 TPU (measured: warmup and trial ambiguous counts both ~9.5k ->
     bucket 16384). Put the production backend's likely shapes here and
-    other scales in ``tier2_aot_buckets`` (AOT compile only --
-    near-free to warm, ruinous to compile in-band).
-    A sweep whose failed subset pads beyond the largest bucket still
-    compiles in-band. Returns the number of programs touched; each
-    call (including its own materialization) rides the transient-error
-    retry, so a flake can never escape to the caller's timed region.
+    other scales in ``tier2_aot_buckets``. A sweep whose failed subset
+    pads beyond the largest bucket still compiles in-band.
+
+    ``cache``: an :class:`compile_pool.AOTCache` (None builds one from
+    ``PYCATKIN_AOT_CACHE`` bound to this spec's fingerprint; False
+    disables the disk layer). ``workers``: compile-pool width (None
+    reads ``PYCATKIN_COMPILE_WORKERS``).
+
+    Returns a :class:`PrewarmStats` (an ``int``: programs touched).
+    Every compile/load/execute rides the transient-error retry, so a
+    flake can never escape to the caller's timed region.
     """
     import time as _time
 
@@ -872,131 +1057,250 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         _log(f"{label}: {_time.perf_counter() - t0:.2f} s")
         return out
 
+    if cache is None:
+        cache = compile_pool.AOTCache(
+            fingerprint=compile_pool.spec_fingerprint(spec))
+    elif cache is False:
+        cache = compile_pool.AOTCache(root="off")
+    _log(f"AOT cache: {cache.root or 'disabled'}; "
+         f"compile pool width {workers or compile_pool.compile_workers()}")
+
+    def _resolve(kind, prog, args, label):
+        """Registry/cache lookup for one program; returns True when an
+        executable is already available (registered now or before)."""
+        key = compile_pool.program_key(kind, args)
+        if compile_pool.lookup(spec, key) is not None:
+            return key, True
+        try:
+            exe = cache.load(key)
+        except compile_pool.CacheMismatch as e:
+            _log(f"{label}: stale AOT entry ({e}); recompiling")
+            exe = None
+        if exe is not None:
+            compile_pool.register(spec, key, exe)
+            _log(f"{label}: loaded from AOT cache")
+            return key, True
+        return key, False
+
+    def _compile_and_publish(job):
+        """Pool task: compile one program, serialize + register it."""
+        exe = call_with_backend_retry(
+            lambda: job["prog"].lower(*job["args"]).compile(),
+            label=f"compile:{job['label']}")
+        cache.save(job["key"], exe)
+        compile_pool.register(spec, job["key"], exe)
+        return exe
+
+    n_compiled = 0
+    n_loaded = 0
+
+    def _ensure(jobs_batch):
+        """Load-or-compile a batch of jobs concurrently."""
+        nonlocal n_compiled, n_loaded
+        to_compile = []
+        for job in jobs_batch:
+            key, have = _resolve(job["kind"], job["prog"], job["args"],
+                                 job["label"])
+            job["key"] = key
+            if have:
+                n_loaded += 1
+            else:
+                to_compile.append(job)
+        if to_compile:
+            t0 = _time.perf_counter()
+            compile_pool.map_compile(
+                [lambda j=job: _compile_and_publish(j)
+                 for job in to_compile], workers)
+            n_compiled += len(to_compile)
+            _log(f"compiled {len(to_compile)} program(s) concurrently "
+                 f"in {_time.perf_counter() - t0:.2f} s")
+
     leaves = jax.tree_util.tree_leaves(conds)
     n = leaves[0].shape[0]
     keys_full = jax.random.split(jax.random.PRNGKey(0), n)
+    backend = _resolve_backend()
+
+    # --- the fast pass first: its solutions seed every later shape ---
+    fast_kind = _steady_kind(_fast_pass_opts(opts), "ptc")
     fast_prog = _steady_program(spec, _fast_pass_opts(opts))
+    fast_job = {"kind": fast_kind, "prog": fast_prog,
+                "args": (conds, keys_full, None),
+                "label": f"fast pass @{n}"}
+    _ensure([fast_job])
 
     def run_fast():
-        r = fast_prog(conds, keys_full, None)
+        r = _registered_call(spec, fast_kind, fast_prog,
+                             (conds, keys_full, None))
         np.asarray(jnp.sum(r.residual))      # sync inside the retry
         return r
 
     res = timed_retry(run_fast, f"fast pass @{n}")
     ys = res.x
-    n_prog = 1
-    if check_stability:
-        ok = jnp.ones(n, dtype=bool)
-        backend = _resolve_backend()
-
-        def run_screen():
-            out = _stability_screen_program(spec, pos_jac_tol,
-                                            backend)(conds, ys, ok)
-            np.asarray(out[2])
-            return out
-
-        timed_retry(run_screen, f"stability screen @{n}")
-        n_prog += 1
-    if tof_mask is not None:
-        mask_arr = jnp.asarray(tof_mask)
-        ok_all = jnp.ones(n, dtype=bool)
-
-        def run_tof():
-            out = _tof_program(spec)(conds, ys, mask_arr, ok_all)
-            np.asarray(out[2])
-            return out
-
-        timed_retry(run_tof, f"tof/activity @{n}")
-        n_prog += 1
+    n_executed = 1
     dyn = jnp.asarray(spec.dynamic_indices)
 
-    def _jac_args(b):
+    # --- build the full job list (args depend on ys) ---
+    jobs: list[dict] = []
+    seen_keys: set = set()
+
+    def _add(kind, prog, args, label, execute, fence):
+        # Dedup on the program key: e.g. the same jac bucket named in
+        # both `buckets` and `tier2_buckets` compiles/executes once.
+        key = compile_pool.program_key(kind, args)
+        if key in seen_keys:
+            return
+        seen_keys.add(key)
+        jobs.append({"kind": kind, "prog": prog, "args": args,
+                     "label": label, "execute": execute,
+                     "fence": fence, "key": key})
+
+    solve_fence = lambda r: jnp.sum(r.residual)           # noqa: E731
+    scalar2_fence = lambda out: out[2]                    # noqa: E731
+    jac_fence = lambda J: jnp.sum(                        # noqa: E731
+        jnp.where(jnp.isfinite(J), J, 0.0))
+
+    if check_stability:
+        _add(_screen_kind(pos_jac_tol, backend),
+             _stability_screen_program(spec, pos_jac_tol, backend),
+             (conds, ys, jnp.ones(n, dtype=bool)),
+             f"stability screen @{n}", True, scalar2_fence)
+    if tof_mask is not None:
+        _add("tof", _tof_program(spec),
+             (conds, ys, jnp.asarray(tof_mask),
+              jnp.ones(n, dtype=bool)),
+             f"tof/activity @{n}", True, scalar2_fence)
+
+    def _bucket_args(b):
         idx = np.arange(b) % n
         sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
                                      conds)
-        return sub, jnp.asarray(ys)[idx]
-
-    def warm_jac(b):
-        """Execute the subset-Jacobian (tier-2) program at bucket b --
-        shared by the rescue-bucket loop and tier2_buckets."""
-        sub, ysub = _jac_args(b)
-        jprog = _jacobian_program(spec)
-
-        def run():
-            J = jprog(sub, ysub)
-            np.asarray(jnp.sum(jnp.where(jnp.isfinite(J), J, 0.0)))
-            return J
-
-        timed_retry(run, f"tier-2 jac @{b}")
-
-    def aot_jac(b):
-        """AOT-compile (no execution) the subset-Jacobian at bucket b
-        -- the ONE recipe for all insurance-shape warming."""
-        sub, ysub = _jac_args(b)
-        jprog = _jacobian_program(spec)
-        timed_retry(lambda: jprog.lower(sub, ysub).compile(),
-                    f"aot tier-2 jac @{b}")
-
-    for b in buckets:
-        idx = np.arange(b) % n
-        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
         keys = jax.random.split(jax.random.PRNGKey(1), b)
         x0 = jnp.asarray(ys)[idx][:, dyn]
+        return sub, keys, x0, jnp.asarray(ys)[idx]
 
-        def run_prog(prog, *args):
-            r = prog(*args)
-            np.asarray(jnp.sum(r.residual))
-            return r
-
+    def _add_solve_bucket(b, execute):
+        sub, keys, x0, _ = _bucket_args(b)
+        tag = "" if execute else "aot "
         # Seeded near-Newton polish (the first rescue pass). The
         # strategy kwarg must match _rescue's call pattern exactly:
         # lru_cache keys on the literal call signature, so an omitted
         # default here would warm a DIFFERENT jit object than the one
         # the sweep executes.
-        prog = _steady_program(spec, _polish_opts(opts), strategy="ptc")
-        timed_retry(lambda p=prog: run_prog(p, sub, keys, x0),
-                    f"polish @{b}")
-        n_prog += 1
+        _add(_steady_kind(_polish_opts(opts), "ptc"),
+             _steady_program(spec, _polish_opts(opts), strategy="ptc"),
+             (sub, keys, x0), f"{tag}polish @{b}", execute, solve_fence)
         for strat in ("ptc", "lm"):
-            prog = _steady_program(spec, opts, strategy=strat)
-            timed_retry(lambda p=prog: run_prog(p, sub, keys, x0),
-                        f"rescue[{strat}] @{b}")
-            n_prog += 1
-        # The stability demote loop rescues with use_x0=False -> x0=None,
-        # a DIFFERENT traced program than the seeded variant.
-        prog = _steady_program(spec, opts, strategy="ptc")
-        timed_retry(lambda: run_prog(prog, sub, keys, None),
-                    f"rescue[ptc,unseeded] @{b}")
-        n_prog += 1
+            _add(_steady_kind(opts, strat),
+                 _steady_program(spec, opts, strategy=strat),
+                 (sub, keys, x0), f"{tag}rescue[{strat}] @{b}",
+                 execute, solve_fence)
+        # The stability demote loop rescues with use_x0=False ->
+        # x0=None, a DIFFERENT traced program than the seeded variant.
+        _add(_steady_kind(opts, "ptc"),
+             _steady_program(spec, opts, strategy="ptc"),
+             (sub, keys, None), f"{tag}rescue[ptc,unseeded] @{b}",
+             execute, solve_fence)
         if check_stability:
-            warm_jac(b)
-            n_prog += 1
+            _add_jac(b, execute)
+
+    def _add_jac(b, execute):
+        sub, _, _, ysub = _bucket_args(b)
+        tag = "" if execute else "aot "
+        _add("jac", _jacobian_program(spec), (sub, ysub),
+             f"{tag}tier-2 jac @{b}", execute, jac_fence)
+
+    for b in buckets:
+        _add_solve_bucket(b, True)
     if check_stability:
         for b in tier2_buckets:
-            warm_jac(b)
-            n_prog += 1
+            _add_jac(b, True)
         for b in tier2_aot_buckets:
-            aot_jac(b)
-            n_prog += 1
+            _add_jac(b, False)
     for b in aot_buckets:
-        idx = np.arange(b) % n
-        sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], conds)
-        keys = jax.random.split(jax.random.PRNGKey(1), b)
-        x0 = jnp.asarray(ys)[idx][:, dyn]
-        prog = _steady_program(spec, _polish_opts(opts), strategy="ptc")
-        timed_retry(lambda p=prog: p.lower(sub, keys, x0).compile(),
-                    f"aot polish @{b}")
-        n_prog += 1
-        for strat, seed_x0 in (("ptc", x0), ("lm", x0), ("ptc", None)):
-            prog = _steady_program(spec, opts, strategy=strat)
-            timed_retry(
-                lambda p=prog, s=seed_x0: p.lower(sub, keys, s).compile(),
-                f"aot rescue[{strat}{'' if seed_x0 is not None else ',unseeded'}] @{b}")
-            n_prog += 1
-        if check_stability:
-            aot_jac(b)
-            n_prog += 1
-    return n_prog
+        _add_solve_bucket(b, False)
+
+    # --- phase B: satisfy every job from cache or the compile pool ---
+    _ensure(jobs)
+
+    # --- phase C: run the executed buckets once (device is serial) ---
+    for job in jobs:
+        if not job["execute"]:
+            continue
+
+        def run(j=job):
+            out = _registered_call(spec, j["kind"], j["prog"],
+                                   j["args"])
+            np.asarray(j["fence"](out))      # sync inside the retry
+            return out
+
+        timed_retry(run, job["label"])
+        n_executed += 1
+
+    stats = PrewarmStats(1 + len(jobs))
+    stats.compiled = n_compiled
+    stats.loaded = n_loaded
+    stats.cache_writes = cache.writes
+    stats.executed = n_executed
+    stats.cache = cache.stats()
+    _log(f"{int(stats)} programs ({n_compiled} compiled, {n_loaded} "
+         f"loaded/registered, {n_executed} executed once)")
+    return stats
+
+
+def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
+                        opts: SolverOptions = SolverOptions(),
+                        check_stability: bool = False,
+                        pos_jac_tol: float = 1e-2,
+                        cache=None) -> int:
+    """Register any AOT-cached executables matching this sweep's
+    full-shape programs -- no compilation, no execution, no device
+    work; a cache miss is free. Returns the number of executables
+    registered.
+
+    The zero-cost sibling of :func:`prewarm_sweep_programs` for
+    processes that solve exactly one sweep and exit (the dispatch
+    workers, parallel/dispatch.py): executing programs just to warm
+    runtime caches would double their solve cost, but deserializing
+    executables some earlier process already compiled is nearly free.
+    Program keys are derived from abstract shapes
+    (``jax.ShapeDtypeStruct``), so no fast pass is needed to obtain
+    result arrays."""
+    if cache is None:
+        cache = compile_pool.AOTCache(
+            fingerprint=compile_pool.spec_fingerprint(spec))
+    if not cache.enabled:
+        return 0
+    n = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    fast_opts = _fast_pass_opts(opts)
+    fast_prog = _steady_program(spec, fast_opts)
+    shapes = jax.eval_shape(fast_prog, conds, keys, None)
+    x_sds = shapes.x                       # abstract [n, n_species]
+    ok_sds = jax.ShapeDtypeStruct((n,), np.dtype(bool))
+    jobs = [(_steady_kind(fast_opts, "ptc"), fast_prog,
+             (conds, keys, None))]
+    if check_stability:
+        backend = _resolve_backend()
+        jobs.append((_screen_kind(pos_jac_tol, backend),
+                     _stability_screen_program(spec, pos_jac_tol,
+                                               backend),
+                     (conds, x_sds, ok_sds)))
+    if tof_mask is not None:
+        jobs.append(("tof", _tof_program(spec),
+                     (conds, x_sds, jnp.asarray(tof_mask), ok_sds)))
+    n_loaded = 0
+    for kind, _prog, args in jobs:
+        key = compile_pool.program_key(kind, args)
+        if compile_pool.lookup(spec, key) is not None:
+            continue
+        try:
+            exe = cache.load(key)
+        except compile_pool.CacheMismatch:
+            continue                       # cannot recompile here
+        if exe is not None:
+            compile_pool.register(spec, key, exe)
+            n_loaded += 1
+    return n_loaded
 
 
 def shard_conditions(conds: Conditions, mesh: Mesh):

@@ -1,0 +1,312 @@
+"""Concurrent program compilation + on-disk AOT executable cache.
+
+Two independent costs dominate a fresh process's time-to-first-sweep:
+
+1. XLA *compilation* of every program shape the sweep can touch
+   (:func:`parallel.batch.prewarm_sweep_programs` warms ~30 programs;
+   measured 136.6 s when compiled strictly sequentially, BENCH_r05).
+   Compiles are GIL-releasing C++ work, so a bounded thread pool
+   (:func:`map_compile`) overlaps them nearly perfectly.
+2. Re-compilation on every *restart*. ``jax.jit``'s in-memory caches
+   die with the process and the persistent XLA cache is disabled on
+   CPU (utils/cache.py). :class:`AOTCache` serializes compiled
+   executables (``jax.experimental.serialize_executable``) under a
+   directory next to ``.jax_cache``; a restarted process deserializes
+   the executable and skips trace+compile entirely.
+
+Loaded/compiled executables are published in a process-wide *registry*
+keyed on (spec, program kind, argument shapes); the sweep hot path
+(parallel/batch.py) consults the registry before falling back to the
+ordinary jitted program, so an AOT-loaded executable is actually what a
+sweep runs -- ``f.lower().compile()`` alone would NOT populate the jit
+dispatch cache, and the "warm" prewarm would be a lie.
+
+Environment switches:
+
+- ``PYCATKIN_COMPILE_WORKERS``: compile-pool width (default
+  ``min(8, os.cpu_count())``; ``1`` restores sequential compiles).
+- ``PYCATKIN_AOT_CACHE``: cache directory (default
+  ``<repo>/.jax_aot_cache``); ``0``/``off``/``none`` disables the
+  on-disk layer (the pool still runs).
+
+Every cache entry records the full :func:`spec_fingerprint` of the
+mechanism it was compiled for; loading an entry against a different
+fingerprint raises :class:`CacheMismatch` (callers that can recompile
+catch it and overwrite). Entries from a different jax version, backend
+or device kind are silently treated as misses -- serialized executables
+are only valid on the toolchain that produced them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+_DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_aot_cache")
+
+_DISABLED = ("0", "off", "none", "disabled")
+
+
+class CacheMismatch(RuntimeError):
+    """An AOT cache entry exists but was written for a different model
+    spec fingerprint: executing it would silently compute the wrong
+    mechanism's physics. Callers that own a compiler recompile and
+    overwrite; everyone else must treat the entry as poison."""
+
+
+def compile_workers() -> int:
+    """Bounded width of the compile pool (``PYCATKIN_COMPILE_WORKERS``,
+    default ``min(8, cpu_count)``, floor 1)."""
+    env = os.environ.get("PYCATKIN_COMPILE_WORKERS", "")
+    if env.strip():
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def spec_fingerprint(spec) -> str:
+    """Content hash of a ModelSpec (field name + dtype/shape/bytes of
+    every array field, repr of the rest) -- the identity a cached
+    executable is bound to. ModelSpec itself hashes by object identity
+    (it keys jit caches), so this is the cross-process stand-in."""
+    import dataclasses
+
+    h = hashlib.sha256()
+    if dataclasses.is_dataclass(spec):
+        items = [(f.name, getattr(spec, f.name))
+                 for f in dataclasses.fields(spec)]
+    elif hasattr(spec, "_asdict"):
+        items = list(spec._asdict().items())
+    else:                                   # duck-typed test doubles
+        items = sorted((k, v) for k, v in vars(spec).items()
+                       if not k.startswith("_"))
+    for name, v in items:
+        h.update(name.encode())
+        if isinstance(v, np.ndarray):
+            h.update(str(v.dtype).encode())
+            h.update(str(v.shape).encode())
+            h.update(np.ascontiguousarray(v).tobytes())
+        else:
+            h.update(repr(v).encode())
+    return h.hexdigest()
+
+
+def _shape_signature(args) -> str:
+    """Deterministic (treedef, dtype, shape) signature of a concrete
+    argument tuple -- what a compiled executable is specialized on.
+    ``None`` subtrees are part of the treedef, so seeded (x0 array) and
+    unseeded (x0=None) variants of the same program get distinct keys."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [repr(treedef)]
+    for leaf in leaves:
+        a = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+        parts.append(f"{a.dtype}{tuple(a.shape)}")
+    return "|".join(parts)
+
+
+def program_key(kind: str, args) -> str:
+    """Stable cache/registry key for one compiled program: the program
+    *kind* (strategy + solver-options repr, from the caller), the
+    argument shape signature, and the executing toolchain (backend,
+    device kind, jax version)."""
+    import jax
+
+    dev = jax.devices()[0]
+    mat = "\x1f".join([kind, _shape_signature(args), dev.platform,
+                       dev.device_kind, jax.__version__])
+    return hashlib.sha256(mat.encode()).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------
+# Process-wide executable registry: (spec, key) -> loaded executable.
+# Holding the spec object itself (identity-hashed) pins its lifetime
+# exactly like the jit program lru_caches in parallel/batch.py;
+# clear_program_caches() clears both together.
+_REGISTRY: dict = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register(spec, key: str, exe) -> None:
+    """Publish a compiled/loaded executable for the sweep hot path."""
+    with _REGISTRY_LOCK:
+        _REGISTRY[(spec, key)] = exe
+
+
+def lookup(spec, key: str):
+    """The registered executable for (spec, key), or None."""
+    return _REGISTRY.get((spec, key))
+
+
+def unregister(spec, key: str) -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.pop((spec, key), None)
+
+
+def clear_registry() -> None:
+    """Drop every registered executable (and the spec references they
+    pin). Called by parallel.batch.clear_program_caches."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()
+
+
+def registry_size() -> int:
+    return len(_REGISTRY)
+
+
+# ---------------------------------------------------------------------
+# On-disk AOT executable cache.
+class AOTCache:
+    """Serialize/deserialize compiled executables under one directory.
+
+    ``root``: cache directory (None reads ``PYCATKIN_AOT_CACHE``, then
+    the default next to ``.jax_cache``; the disable sentinels yield a
+    cache whose ``enabled`` is False and whose load/save are no-ops).
+    ``fingerprint``: the :func:`spec_fingerprint` entries are bound to.
+
+    Writes are atomic (temp file + rename) so a killed process can
+    never publish a torn entry; any unreadable/stale entry loads as a
+    miss, and only a *fingerprint* disagreement -- a readable entry for
+    the wrong mechanism -- raises :class:`CacheMismatch`.
+    """
+
+    def __init__(self, root: str | None = None, fingerprint: str = ""):
+        if root is None:
+            env = os.environ.get("PYCATKIN_AOT_CACHE", "").strip()
+            if env.lower() in _DISABLED:
+                root = ""
+            else:
+                root = env or _DEFAULT_ROOT
+        elif str(root).strip().lower() in _DISABLED:
+            root = ""
+        self.root = str(root) if root else ""
+        self.fingerprint = str(fingerprint)
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+        self.mismatches = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.root)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.aot")
+
+    def load(self, key: str):
+        """Deserialize the executable cached under ``key``.
+
+        Returns the loaded executable (callable with the original
+        arguments) or None on miss/stale entry; raises
+        :class:`CacheMismatch` when the entry's recorded spec
+        fingerprint differs from this cache's."""
+        if not self.enabled:
+            return None
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        path = self._path(key)
+        try:
+            with open(path, "rb") as fh:
+                entry = pickle.load(fh)
+        except (OSError, pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError):
+            self.misses += 1
+            return None
+        dev = jax.devices()[0]
+        if (entry.get("jax") != jax.__version__
+                or entry.get("backend") != dev.platform
+                or entry.get("device_kind") != dev.device_kind):
+            self.misses += 1            # stale toolchain: plain miss
+            return None
+        if entry.get("fingerprint") != self.fingerprint:
+            self.mismatches += 1
+            raise CacheMismatch(
+                f"AOT cache entry {os.path.basename(path)} was compiled "
+                f"for spec fingerprint "
+                f"{str(entry.get('fingerprint'))[:12]}..., expected "
+                f"{self.fingerprint[:12]}... -- refusing to execute "
+                f"another mechanism's program (recompile to overwrite)")
+        try:
+            exe = se.deserialize_and_load(
+                entry["payload"], entry["in_tree"], entry["out_tree"])
+        except Exception:               # corrupt payload: plain miss
+            self.misses += 1
+            return None
+        self.hits += 1
+        return exe
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize ``compiled`` (a jax ``Compiled``) under ``key``.
+        Returns True on success; serialization failures (unsupported
+        backend, unpicklable treedefs, full disk) degrade to False --
+        the in-process registry still carries the executable."""
+        if not self.enabled:
+            return False
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        try:
+            payload, in_tree, out_tree = se.serialize(compiled)
+            dev = jax.devices()[0]
+            entry = {"fingerprint": self.fingerprint,
+                     "jax": jax.__version__,
+                     "backend": dev.platform,
+                     "device_kind": dev.device_kind,
+                     "payload": payload,
+                     "in_tree": in_tree,
+                     "out_tree": out_tree}
+            blob = pickle.dumps(entry)
+            os.makedirs(self.root, exist_ok=True)
+            tmp = self._path(key) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, self._path(key))
+        except Exception:
+            return False
+        self.writes += 1
+        return True
+
+    def stats(self) -> dict:
+        return {"root": self.root or None, "hits": self.hits,
+                "misses": self.misses, "writes": self.writes,
+                "mismatches": self.mismatches}
+
+
+def map_compile(tasks, workers: int | None = None):
+    """Run ``tasks`` (zero-arg callables, each returning a compiled
+    executable or raising) on a bounded thread pool and return their
+    results in order; exceptions propagate to the caller after all
+    tasks have been collected (re-raising the FIRST failure, so one
+    flaky compile does not orphan the others mid-flight).
+
+    XLA compilation releases the GIL (it is C++ work), so wall-clock
+    scales nearly linearly with pool width up to the machine's cores.
+    """
+    tasks = list(tasks)
+    if not tasks:
+        return []
+    workers = workers or compile_workers()
+    if workers <= 1 or len(tasks) == 1:
+        return [t() for t in tasks]
+    results = [None] * len(tasks)
+    errors: list[tuple[int, BaseException]] = []
+    with ThreadPoolExecutor(max_workers=min(workers, len(tasks))) as ex:
+        futs = {ex.submit(t): i for i, t in enumerate(tasks)}
+        for fut, i in futs.items():
+            try:
+                results[i] = fut.result()
+            except BaseException as e:      # noqa: BLE001 - re-raised
+                errors.append((i, e))
+    if errors:
+        raise errors[0][1]
+    return results

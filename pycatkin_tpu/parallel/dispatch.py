@@ -62,7 +62,8 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                    tof_terms=None, check_stability: bool = False,
                    worker_env: Optional[dict] = None,
                    timeout: Optional[float] = None,
-                   on_failure: str = "raise") -> dict:
+                   on_failure: str = "raise",
+                   aot_cache: Optional[str] = None) -> dict:
     """Run ``sweep_steady_state`` over ``conds`` split across
     ``n_workers`` independent processes; returns the merged result dict
     (same keys as the in-process sweep, lane order preserved).
@@ -81,6 +82,15 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
       otherwise JAX-free parent), recording a degradation event per
       block; only if the in-process re-solve also fails does the
       error propagate.
+
+    ``aot_cache``: directory of the shared AOT executable cache
+    (parallel/compile_pool.py) threaded to every worker via
+    ``PYCATKIN_AOT_CACHE``; each worker then registers any cached
+    executables matching its block's programs before solving
+    (:func:`parallel.batch.warm_from_aot_cache` -- deserialization
+    only, a miss costs nothing), so N workers don't each recompile
+    programs some earlier run already built. None inherits the
+    parent's environment unchanged.
     """
     import tempfile
 
@@ -115,6 +125,8 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
         with open(cfg_path, "w") as f:
             json.dump(cfg, f)
         env = dict(os.environ)
+        if aot_cache is not None:
+            env["PYCATKIN_AOT_CACHE"] = str(aot_cache)
         if worker_env:
             env.update({k: str(v) for k, v in worker_env.items()})
         procs.append((i, out_path, subprocess.Popen(
@@ -209,6 +221,13 @@ def _worker(cfg_path: str, inject_faults: bool = True) -> None:
     conds = load_conditions(cfg["conds"])
     mask = (engine.tof_mask_for(sim.spec, cfg["tof_terms"])
             if cfg.get("tof_terms") else None)
+    # Deserialize (never compile/execute) any AOT-cached executables
+    # matching this block's programs -- free on miss, and it spares a
+    # worker fleet from redundantly recompiling what one run already
+    # built (the cache dir arrives via PYCATKIN_AOT_CACHE).
+    from .batch import warm_from_aot_cache
+    warm_from_aot_cache(sim.spec, conds, tof_mask=mask,
+                        check_stability=cfg.get("check_stability", False))
     out = sweep_steady_state(sim.spec, conds, tof_mask=mask,
                              check_stability=cfg.get("check_stability",
                                                      False))

@@ -83,7 +83,8 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
                                journal: str | SweepJournal | None = None,
                                resume: bool = False,
                                policy: DegradationPolicy | None = None,
-                               verbose: bool = False):
+                               verbose: bool = False,
+                               pipeline: bool = True):
     """Run ``sweep_steady_state`` chunk by chunk with journaling and
     graceful degradation.
 
@@ -107,6 +108,17 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     ladder is journaled with status ``"quarantined"`` -- like
     ``"salvaged"``, deliberately NOT a completed status, so a resume
     re-solves exactly the lanes that degraded.
+
+    ``pipeline``: double-buffer chunk execution -- chunk ``k+1`` is
+    dispatched on a single worker thread while the main thread triages
+    and journals (fsync'd ``.npz`` write) chunk ``k``, keeping the
+    device busy during checkpoint I/O. Chunk SOLVES stay strictly
+    serialized (the worker is one thread deep) and journal records are
+    written in chunk order from the main thread, so ladder/journal
+    semantics and results are bit-identical to the serial loop; the
+    runner degrades to the serial loop automatically under an active
+    fault-injection plan (whose per-site occurrence drills assume
+    solve and triage interleave strictly).
     """
     import jax
     import jax.numpy as jnp
@@ -133,14 +145,11 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     report = {"n_chunks": n_chunks, "chunk": chunk, "reused": [],
               "degraded": [], "salvaged": [], "quarantined": [],
               "events": []}
-    parts: list[dict] = []
-    for ci in range(n_chunks):
+    def solve_chunk(ci: int):
+        """Dispatch one chunk through the full sweep + ladder machinery
+        (the pipelined half: no journal/report access in here)."""
         a, b = ci * chunk, min(n, (ci + 1) * chunk)
         site = f"chunk:{ci}"
-        if ci in done:
-            parts.append(jr.load_chunk(done[ci]))
-            report["reused"].append(ci)
-            continue
         sub = jax.tree_util.tree_map(lambda x: x[a:b], conds_np)
 
         def run(device=None, _sub=sub, _site=site):
@@ -156,44 +165,48 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
                 out = {k: np.asarray(v) for k, v in out.items()}
             return faults.transform(_site, out)
 
-        out, events = run_chunk_with_ladder(
+        return run_chunk_with_ladder(
             run, label=site, policy=policy, validate=chunk_verdict)
-        if out is None:
-            out = salvage_arrays(spec, b - a, tof_mask, check_stability)
-            status = "salvaged"
-            report["salvaged"].append(ci)
-        else:
-            status = "done"
-            if events:
-                report["degraded"].append(ci)
-            # Quarantined lanes that the rescue ladder could NOT
-            # re-converge leave the chunk incomplete: record the
-            # quarantine rung against this chunk's site and journal a
-            # non-"done" status so a resume re-solves those lanes
-            # (status "quarantined" is not in journal._COMPLETE).
-            quar = np.asarray(out.get("quarantined",
-                                      np.zeros(b - a)), dtype=bool)
-            succ = np.asarray(out["success"], dtype=bool)
-            if (quar & ~succ).any():
-                lanes = (a + np.flatnonzero(quar & ~succ)).tolist()
-                events.append({
-                    "label": site, "rung": "quarantine",
-                    "detail": f"{len(lanes)} quarantined lane(s) "
-                              f"unrecovered; chunk left incomplete "
-                              f"for resume", "lanes": lanes})
-                status = "quarantined"
-                report["quarantined"].append(ci)
-        n_failed = int(np.sum(~np.asarray(out["success"], dtype=bool)))
-        if jr is not None:
-            jr.record_chunk(ci, a, b, status, arrays=out, events=events,
-                            n_failed=n_failed)
-        report["events"].extend(events)
-        parts.append(out)
-        if verbose:
-            import sys
-            print(f"chunk {ci + 1}/{n_chunks} [{a}:{b}] {status} "
-                  f"({n_failed} failed lane(s))", file=sys.stderr,
-                  flush=True)
+
+    todo = [ci for ci in range(n_chunks) if ci not in done]
+    # One-deep double buffering: while the main thread triages/journals
+    # chunk k, the worker solves chunk k+1. Disabled under an active
+    # fault plan, whose occurrence counters are drill scripts that
+    # assume a strict solve->triage->solve interleave.
+    use_pipeline = (pipeline and len(todo) > 1
+                    and faults.active_plan() is None)
+    executor = None
+    futures: dict = {}
+    if use_pipeline:
+        from concurrent.futures import ThreadPoolExecutor
+        executor = ThreadPoolExecutor(max_workers=1)
+        futures[todo[0]] = executor.submit(solve_chunk, todo[0])
+
+    parts: list[dict] = []
+    try:
+        for ci in range(n_chunks):
+            a, b = ci * chunk, min(n, (ci + 1) * chunk)
+            site = f"chunk:{ci}"
+            if ci in done:
+                parts.append(jr.load_chunk(done[ci]))
+                report["reused"].append(ci)
+                continue
+            if executor is not None:
+                nxt = todo.index(ci) + 1
+                if nxt < len(todo):
+                    futures[todo[nxt]] = executor.submit(
+                        solve_chunk, todo[nxt])
+                out, events = futures.pop(ci).result()
+            else:
+                out, events = solve_chunk(ci)
+            parts.append(_triage_chunk(ci, a, b, out, events, spec,
+                                       tof_mask, check_stability, jr,
+                                       report, n_chunks, verbose))
+    finally:
+        if executor is not None:
+            for fut in futures.values():
+                fut.cancel()
+            executor.shutdown(wait=True)
 
     keys = parts[0].keys()
     out = {k: np.concatenate([p[k] for p in parts], axis=0)
@@ -201,3 +214,48 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     report["n_failed_lanes"] = int(
         np.sum(~np.asarray(out["success"], dtype=bool)))
     return out, report
+
+
+def _triage_chunk(ci, a, b, out, events, spec, tof_mask,
+                  check_stability, jr, report, n_chunks, verbose):
+    """Main-thread half of the chunk loop: salvage/quarantine triage,
+    journal record (always written in chunk order) and reporting.
+    Factored out so the double-buffered and serial paths share one
+    copy of the PR-1/PR-2 semantics."""
+    site = f"chunk:{ci}"
+    if out is None:
+        out = salvage_arrays(spec, b - a, tof_mask, check_stability)
+        status = "salvaged"
+        report["salvaged"].append(ci)
+    else:
+        status = "done"
+        if events:
+            report["degraded"].append(ci)
+        # Quarantined lanes that the rescue ladder could NOT
+        # re-converge leave the chunk incomplete: record the
+        # quarantine rung against this chunk's site and journal a
+        # non-"done" status so a resume re-solves those lanes
+        # (status "quarantined" is not in journal._COMPLETE).
+        quar = np.asarray(out.get("quarantined",
+                                  np.zeros(b - a)), dtype=bool)
+        succ = np.asarray(out["success"], dtype=bool)
+        if (quar & ~succ).any():
+            lanes = (a + np.flatnonzero(quar & ~succ)).tolist()
+            events.append({
+                "label": site, "rung": "quarantine",
+                "detail": f"{len(lanes)} quarantined lane(s) "
+                          f"unrecovered; chunk left incomplete "
+                          f"for resume", "lanes": lanes})
+            status = "quarantined"
+            report["quarantined"].append(ci)
+    n_failed = int(np.sum(~np.asarray(out["success"], dtype=bool)))
+    if jr is not None:
+        jr.record_chunk(ci, a, b, status, arrays=out, events=events,
+                        n_failed=n_failed)
+    report["events"].extend(events)
+    if verbose:
+        import sys
+        print(f"chunk {ci + 1}/{n_chunks} [{a}:{b}] {status} "
+              f"({n_failed} failed lane(s))", file=sys.stderr,
+              flush=True)
+    return out

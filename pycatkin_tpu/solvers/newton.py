@@ -291,6 +291,44 @@ def _verdict_tests(x, fnorm, groups_dyn, opts: SolverOptions):
     return rate_ok, pos_ok, sums_ok
 
 
+def lane_finite_mask(x, residual):
+    """Per-lane finiteness of a batched solution block: every entry of
+    the stored state AND the residual is finite. The quarantine layer
+    (parallel/batch.py) demotes ``success & ~finite`` lanes -- a
+    silently poisoned result's exact signature -- and the fused sweep
+    tail packs the same mask into its diagnostics bundle, so both
+    layers share this single definition."""
+    return (jnp.all(jnp.isfinite(jnp.asarray(x)), axis=-1)
+            & jnp.isfinite(jnp.asarray(residual)))
+
+
+def packed_sweep_diagnostics(success, quarantined, ambiguous=None,
+                             demoted=None, n_negative_tof=None):
+    """Pack every cross-lane sweep verdict reduction into ONE small
+    integer vector: ``[n_failed, n_quarantined, n_ambiguous, n_demoted,
+    n_negative_tof]`` (absent entries report -1).
+
+    The point is host-sync economics, not arithmetic: a sweep that
+    fetched each of these scalars separately pays one blocking
+    device->host round trip per fetch (~0.8-1.2 s each on the tunneled
+    backend -- the r05 throughput regression). Packing them means a
+    clean sweep materializes exactly one bundle
+    (utils/profiling.host_sync) and branches on host ints from there.
+    """
+    def _count(v):
+        return jnp.sum(v).astype(jnp.int32) if v is not None and (
+            getattr(v, "ndim", 1) > 0) else (
+            jnp.asarray(-1 if v is None else v, dtype=jnp.int32))
+
+    return jnp.stack([
+        jnp.sum(~jnp.asarray(success)).astype(jnp.int32),
+        jnp.sum(jnp.asarray(quarantined)).astype(jnp.int32),
+        _count(ambiguous),
+        _count(demoted),
+        _count(n_negative_tof),
+    ])
+
+
 def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
     """Convergence tests (reference solver.py:69-120 minus the host-only
     eigenvalue check): normalized residual small, coverages non-negative,

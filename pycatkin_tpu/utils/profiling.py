@@ -22,6 +22,7 @@ from __future__ import annotations
 import cProfile
 import io
 import pstats
+import threading
 import time
 from contextlib import contextmanager
 
@@ -57,6 +58,78 @@ def drain_events() -> list:
     out = list(_EVENTS)
     _EVENTS.clear()
     return out
+
+
+# ---------------------------------------------------------------------
+# Host-sync accounting. Every BLOCKING device->host materialization on
+# the sweep hot path goes through :func:`host_sync` -- the one choke
+# point -- so a process-wide counter can certify the sync budget: on the
+# tunneled axon backend each materialization call costs ~0.8-1.2 s of
+# round trip regardless of payload, which makes "how many times did the
+# host block on the device" the primary latency metric of a sweep. The
+# budget is CONTRACTUAL: a clean (zero-failure) sweep_steady_state may
+# perform at most 3 counted syncs (tests/test_sync_budget.py), and
+# tools/lint_host_syncs.py statically flags raw np.asarray/int(jnp.
+# materializations in the hot-path functions that bypass this counter.
+_SYNC_LOCK = threading.Lock()
+_SYNC_COUNT = 0
+_SYNC_LABELS: list = []
+
+
+def host_sync(value, label: str = ""):
+    """Materialize ``value`` onto the host (the blocking sync point) and
+    count it. Returns the numpy array. ``label`` tags the site for
+    debugging (see :func:`sync_labels`)."""
+    global _SYNC_COUNT
+    with _SYNC_LOCK:
+        _SYNC_COUNT += 1
+        _SYNC_LABELS.append(label)
+    return np.asarray(value)
+
+
+def sync_count() -> int:
+    """Process-wide number of counted host syncs since the last
+    :func:`reset_sync_count`."""
+    return _SYNC_COUNT
+
+
+def sync_labels() -> list:
+    """Labels of the counted syncs since the last reset (one entry per
+    :func:`host_sync` call, in order)."""
+    return list(_SYNC_LABELS)
+
+
+def reset_sync_count() -> int:
+    """Zero the host-sync counter; returns the count it held."""
+    global _SYNC_COUNT
+    with _SYNC_LOCK:
+        prior = _SYNC_COUNT
+        _SYNC_COUNT = 0
+        _SYNC_LABELS.clear()
+    return prior
+
+
+@contextmanager
+def sync_budget():
+    """Context manager measuring host syncs inside a block::
+
+        with sync_budget() as b:
+            sweep_steady_state(...)
+        assert b.count <= 3
+
+    Concurrent syncs from other threads land in the same process-wide
+    counter (the measurement is a budget, not an attribution)."""
+    class _Budget:
+        count = 0
+        labels: list = []
+    b = _Budget()
+    start = _SYNC_COUNT
+    start_len = len(_SYNC_LABELS)
+    try:
+        yield b
+    finally:
+        b.count = _SYNC_COUNT - start
+        b.labels = _SYNC_LABELS[start_len:]
 
 
 def checksum_fence():

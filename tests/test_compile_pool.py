@@ -1,0 +1,191 @@
+"""Compile pool + AOT executable cache unit tests.
+
+Pins the ISSUE-3 contracts: a serialized executable reloaded from a
+fresh cache object returns bit-identical results to the original jit
+program; loading an entry against a different spec fingerprint raises
+``CacheMismatch`` (never silently executes another mechanism's
+physics); toolchain mismatches are silent misses; and the registry +
+prewarm integration actually routes sweeps through loaded executables.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import compile_pool
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         clear_program_caches,
+                                         prewarm_sweep_programs,
+                                         sweep_steady_state,
+                                         warm_from_aot_cache)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    clear_program_caches()
+    yield
+    clear_program_caches()
+
+
+def test_aot_cache_round_trip_bit_identical(tmp_path):
+    @jax.jit
+    def f(x, y):
+        return jnp.sin(x) @ y + jnp.sum(x, axis=-1)
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)))
+    y = jnp.asarray(np.random.default_rng(1).normal(size=(8,)))
+    compiled = f.lower(x, y).compile()
+    want = np.asarray(compiled(x, y))
+
+    cache = compile_pool.AOTCache(root=str(tmp_path), fingerprint="fp0")
+    key = compile_pool.program_key("test:f", (x, y))
+    assert cache.save(key, compiled)
+    assert (tmp_path / f"{key}.aot").exists()
+
+    fresh = compile_pool.AOTCache(root=str(tmp_path), fingerprint="fp0")
+    exe = fresh.load(key)
+    assert exe is not None and fresh.hits == 1
+    got = np.asarray(exe(x, y))
+    np.testing.assert_array_equal(got, want)   # bit-identical
+
+
+def test_cache_mismatch_on_changed_fingerprint(tmp_path):
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    x = jnp.arange(4.0)
+    compiled = f.lower(x).compile()
+    cache = compile_pool.AOTCache(root=str(tmp_path),
+                                  fingerprint="mechanism-A")
+    key = compile_pool.program_key("test:g", (x,))
+    assert cache.save(key, compiled)
+
+    other = compile_pool.AOTCache(root=str(tmp_path),
+                                  fingerprint="mechanism-B")
+    with pytest.raises(compile_pool.CacheMismatch):
+        other.load(key)
+    assert other.mismatches == 1
+
+
+def test_toolchain_mismatch_is_silent_miss(tmp_path):
+    import pickle
+
+    cache = compile_pool.AOTCache(root=str(tmp_path), fingerprint="fp")
+    path = cache._path("deadbeef")
+    entry = {"fingerprint": "fp", "jax": "0.0.0-not-this-version",
+             "backend": "cpu", "device_kind": "cpu",
+             "payload": b"", "in_tree": None, "out_tree": None}
+    (tmp_path).mkdir(exist_ok=True)
+    with open(path, "wb") as fh:
+        pickle.dump(entry, fh)
+    assert cache.load("deadbeef") is None
+    assert cache.misses == 1
+
+
+def test_corrupt_entry_is_miss_and_disabled_cache_noops(tmp_path):
+    cache = compile_pool.AOTCache(root=str(tmp_path), fingerprint="fp")
+    with open(cache._path("cafe"), "wb") as fh:
+        fh.write(b"not a pickle")
+    assert cache.load("cafe") is None and cache.misses == 1
+
+    off = compile_pool.AOTCache(root="off")
+    assert not off.enabled
+    assert off.load("anything") is None
+    assert off.save("anything", object()) is False
+
+
+def test_program_key_separates_shapes_kinds_and_x0_none():
+    a = (jnp.zeros((4, 3)), None)
+    b = (jnp.zeros((4, 3)), jnp.zeros((4, 2)))
+    c = (jnp.zeros((8, 3)), None)
+    k = compile_pool.program_key
+    assert k("s", a) != k("s", b)      # x0=None vs array: distinct
+    assert k("s", a) != k("s", c)      # lane count: distinct
+    assert k("s", a) != k("t", a)      # kind: distinct
+    assert k("s", a) == k("s", a)      # deterministic
+
+
+def test_map_compile_runs_all_and_reraises_first_error():
+    calls = []
+
+    def ok(i):
+        return lambda: calls.append(i) or i
+
+    assert compile_pool.map_compile([]) == []
+    assert compile_pool.map_compile([ok(0), ok(1), ok(2)],
+                                    workers=3) == [0, 1, 2]
+    assert sorted(calls) == [0, 1, 2]
+
+    def boom():
+        raise RuntimeError("compile failed")
+
+    calls.clear()
+    with pytest.raises(RuntimeError, match="compile failed"):
+        compile_pool.map_compile([ok(0), boom, ok(1)], workers=2)
+    assert sorted(calls) == [0, 1]     # siblings were not orphaned
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 24
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(420.0, 780.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def test_prewarm_populates_cache_and_sweeps_bit_identical(tmp_path,
+                                                          problem):
+    spec, conds, mask = problem
+    cache = compile_pool.AOTCache(
+        root=str(tmp_path),
+        fingerprint=compile_pool.spec_fingerprint(spec))
+
+    stats = prewarm_sweep_programs(spec, conds, tof_mask=mask,
+                                   buckets=(), cache=cache)
+    assert int(stats) >= 2 and stats.compiled >= 2
+    assert stats.cache_writes == stats.compiled
+    baseline = sweep_steady_state(spec, conds, tof_mask=mask)
+
+    # A "restarted process": drop every in-process cache, reload the
+    # executables from disk only, and re-run the sweep through them.
+    clear_program_caches()
+    cache2 = compile_pool.AOTCache(
+        root=str(tmp_path),
+        fingerprint=compile_pool.spec_fingerprint(spec))
+    stats2 = prewarm_sweep_programs(spec, conds, tof_mask=mask,
+                                    buckets=(), cache=cache2)
+    assert stats2.compiled == 0
+    assert stats2.loaded == int(stats2)
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    for key in ("y", "tof", "activity", "residual", "success"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(baseline[key]),
+                                      err_msg=key)
+
+
+def test_warm_from_aot_cache_registers_without_compiling(tmp_path,
+                                                         problem):
+    spec, conds, mask = problem
+    fp = compile_pool.spec_fingerprint(spec)
+    cache = compile_pool.AOTCache(root=str(tmp_path), fingerprint=fp)
+
+    # Empty cache: zero registrations, zero errors.
+    assert warm_from_aot_cache(spec, conds, tof_mask=mask,
+                               cache=cache) == 0
+
+    prewarm_sweep_programs(spec, conds, tof_mask=mask, buckets=(),
+                           cache=cache)
+    clear_program_caches()
+    n = warm_from_aot_cache(
+        spec, conds, tof_mask=mask,
+        cache=compile_pool.AOTCache(root=str(tmp_path), fingerprint=fp))
+    assert n >= 1
+    assert compile_pool.registry_size() == n

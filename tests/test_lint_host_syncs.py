@@ -1,0 +1,67 @@
+"""tools/lint_host_syncs.py: the hot path stays free of uncounted
+blocking materializations, and the lint itself flags/excuses the right
+idioms."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, "tools")
+import lint_host_syncs  # noqa: E402
+
+
+def test_repo_hot_path_is_clean():
+    proc = subprocess.run(
+        [sys.executable, "tools/lint_host_syncs.py"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.fixture
+def lint_target(tmp_path, monkeypatch):
+    def write(source):
+        path = tmp_path / "batch.py"
+        path.write_text(textwrap.dedent(source))
+        monkeypatch.setattr(lint_host_syncs, "TARGET", str(path))
+        return path
+    return write
+
+
+def test_flags_raw_materializations_in_hot_functions(lint_target):
+    lint_target("""
+        def _finish_sweep(res):
+            a = np.asarray(res.success)
+            b = int(jnp.sum(res.x))
+            return a, b
+
+        def _not_hot(res):
+            return np.asarray(res.x)
+    """)
+    flagged = lint_host_syncs.collect_syncs(lint_host_syncs.TARGET)
+    assert len(flagged) == 2
+    assert any("np.asarray" in src for _, src in flagged)
+    assert any("int(jnp.sum" in src for _, src in flagged)
+
+
+def test_counted_and_annotated_syncs_pass(lint_target):
+    lint_target("""
+        def _rescue(res):
+            n = int(host_sync(jnp.sum(res.x), "rescue pre-check"))
+            mask = np.asarray(res.success)  # sync-ok: failure path
+            return n, mask
+    """)
+    assert lint_host_syncs.collect_syncs(lint_host_syncs.TARGET) == []
+
+
+def test_nested_closures_inside_hot_functions_count(lint_target):
+    lint_target("""
+        def sweep_steady_state(res):
+            def run():
+                return np.asarray(res.x)
+            return run()
+    """)
+    flagged = lint_host_syncs.collect_syncs(lint_host_syncs.TARGET)
+    assert len(flagged) == 1

@@ -1,0 +1,71 @@
+"""Host-sync budget contract of the sweep hot path.
+
+A clean (zero-failure) ``sweep_steady_state`` may perform at most 3
+counted blocking device->host materializations (the ISSUE-3 budget; the
+implementation spends 2: the solve fence and the packed sweep-tail
+diagnostics bundle). On the tunneled production backend each counted
+sync costs ~0.8-1.2 s of round trip regardless of payload, so a PR that
+quietly reintroduces a per-stage ``np.asarray``/``int(jnp.sum(...))``
+pull would tax every sweep; this test makes that a hard failure, and
+tools/lint_host_syncs.py flags the raw idioms statically.
+"""
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.utils import profiling
+
+MAX_CLEAN_SYNCS = 3
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 48
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(400.0, 800.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def _run_clean(spec, conds, mask, **kwargs):
+    with profiling.sync_budget() as budget:
+        out = sweep_steady_state(spec, conds, tof_mask=mask, **kwargs)
+    assert bool(np.all(np.asarray(out["success"]))), \
+        "budget only applies to a clean sweep; this one had failures"
+    return out, budget
+
+
+def test_clean_sweep_within_sync_budget(problem):
+    spec, conds, mask = problem
+    sweep_steady_state(spec, conds, tof_mask=mask)   # warm, uncounted
+    _, budget = _run_clean(spec, conds, mask)
+    assert budget.count <= MAX_CLEAN_SYNCS, (
+        f"clean sweep spent {budget.count} counted host syncs "
+        f"(budget {MAX_CLEAN_SYNCS}): {budget.labels}")
+
+
+def test_clean_sweep_with_stability_within_sync_budget(problem):
+    spec, conds, mask = problem
+    sweep_steady_state(spec, conds, tof_mask=mask, check_stability=True)
+    out, budget = _run_clean(spec, conds, mask, check_stability=True)
+    assert "stable" in out
+    assert budget.count <= MAX_CLEAN_SYNCS, (
+        f"clean sweep (stability on) spent {budget.count} counted host "
+        f"syncs (budget {MAX_CLEAN_SYNCS}): {budget.labels}")
+
+
+def test_sync_counter_counts_and_resets():
+    import jax.numpy as jnp
+    profiling.reset_sync_count()
+    v = profiling.host_sync(jnp.arange(3.0), "unit test")
+    assert isinstance(v, np.ndarray) and v.shape == (3,)
+    assert profiling.sync_count() == 1
+    assert profiling.sync_labels() == ["unit test"]
+    assert profiling.reset_sync_count() == 1
+    assert profiling.sync_count() == 0
