@@ -2,18 +2,20 @@
 # backend; the faults lane isolates the fault-injection / degradation /
 # journal-resume tests and the validate lane the input-validation-gate
 # / quarantine tests (both markers stay inside the default `not slow`
-# selection). `lint-faults` statically checks that every fault-site
-# label in pycatkin_tpu/ is documented in docs/failure_model.md;
-# `lint-syncs` that the sweep hot path has no uncounted host
-# materializations (docs/index.md "Performance"). `bench-smoke` is the
-# end-to-end canary: an 8x8 CPU sweep with prewarm that fails on any
-# crash or on a clean sweep exceeding the host-sync budget.
+# selection). `lint` runs the unified pclint static-analysis pass
+# (docs/static_analysis.md): host-sync budget (PCL001), fault-site
+# registry (PCL002), jit purity (PCL003), tracer hygiene (PCL004),
+# dtype policy (PCL005) and the env-var registry (PCL006);
+# `lint-syncs`/`lint-faults` remain as single-rule aliases.
+# `bench-smoke` is the end-to-end canary: pclint plus an 8x8 CPU sweep
+# with prewarm that fails on any crash, any new lint finding, or a
+# clean sweep exceeding the host-sync budget.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test test-faults test-validate test-all lint-faults lint-syncs \
-	bench-smoke
+.PHONY: test test-faults test-validate test-all lint lint-faults \
+	lint-syncs lint-baseline bench-smoke
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -24,14 +26,20 @@ test-faults:
 test-validate:
 	$(PYTEST) -m validate
 
-test-all:
+test-all: lint
 	$(PYTEST) -m ''
 
-lint-faults:
-	python tools/lint_fault_sites.py
+lint:
+	python tools/pclint.py
 
 lint-syncs:
-	python tools/lint_host_syncs.py
+	python tools/pclint.py --rules PCL001
+
+lint-faults:
+	python tools/pclint.py --rules PCL002
+
+lint-baseline:
+	python tools/pclint.py --update-baseline
 
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke

@@ -358,14 +358,29 @@ def main():
 
 
 def smoke_main():
-    """``bench.py --smoke``: the ``make bench-smoke`` CI lane. An 8x8
-    sweep with prewarm on whatever backend is available (CPU in CI),
-    exiting non-zero on any crash OR on a clean sweep spending more
+    """``bench.py --smoke``: the ``make bench-smoke`` CI lane. The
+    pclint static-analysis gate followed by an 8x8 sweep with prewarm
+    on whatever backend is available (CPU in CI), exiting non-zero on
+    any new lint finding, any crash, OR on a clean sweep spending more
     than 5 counted host syncs -- the cheap end-to-end canary that the
-    pipelined executor and the sync budget survive integration, not a
-    throughput record. Prints exactly one JSON line."""
+    correctness gates and the pipelined executor survive integration,
+    not a throughput record. Prints exactly one JSON line."""
     global GRID_N
     GRID_N = 8
+
+    # Static gate first: a lint breach fails the lane before any
+    # compile time is spent (baseline-suppressed findings pass).
+    from pycatkin_tpu.lint import lint_repo
+    lint_active = lint_repo()
+    if lint_active:
+        for f in lint_active:
+            log(f"bench-smoke: lint: {f.location()}: {f.rule} "
+                f"{f.message}")
+        print(json.dumps({"metric": "smoke", "lint_ok": False,
+                          "lint_findings": len(lint_active)}))
+        log(f"bench-smoke: FAIL -- {len(lint_active)} pclint "
+            f"finding(s); run `make lint` for details")
+        return 1
 
     from pycatkin_tpu.utils.cache import enable_persistent_cache
     enable_persistent_cache()
@@ -411,6 +426,8 @@ def smoke_main():
         "sync_labels": budget.labels,
         "max_syncs": max_syncs,
         "sync_budget_ok": not breach,
+        "lint_ok": True,
+        "lint_findings": 0,
     }
     print(json.dumps(result))
     if breach:

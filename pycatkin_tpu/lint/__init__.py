@@ -1,0 +1,53 @@
+"""pclint: the repo's unified static-analysis framework.
+
+One extensible AST-checker pass (``tools/pclint.py`` / ``make lint`` /
+``python -m pycatkin_tpu.lint``) enforcing every statically-checkable
+correctness contract:
+
+========  ================  =============================================
+rule      name              contract
+========  ================  =============================================
+PCL001    host-sync         no uncounted device->host materializations
+                            in the sweep hot path (hotpath registry
+                            shared with tests/test_sync_budget.py)
+PCL002    fault-sites       every fault-site label documented in
+                            docs/failure_model.md
+PCL003    jit-purity        no side effects inside jitted functions
+PCL004    tracer-leak       no Python control flow / np.* host calls on
+                            traced values inside jitted functions
+PCL005    dtype-discipline  no hardcoded float64 in ops/ and solvers/
+PCL006    env-registry      every PYCATKIN_* env key documented in
+                            docs/index.md
+========  ================  =============================================
+
+Suppressions: inline ``# pclint: disable=<rule> -- <reason>`` (any line
+of the flagged span) or the committed ``lint_baseline.json``
+(:mod:`pycatkin_tpu.lint.baseline`). Full docs:
+``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+from . import baseline
+from .core import (Checker, Finding, LintResult, all_checkers,
+                   checkers_for, lint_file, register, run_lint)
+from .hotpath import HOT_FUNCTIONS, HOT_PATH_FILES, MAX_CLEAN_SYNCS
+
+__all__ = [
+    "Checker", "Finding", "LintResult", "all_checkers", "checkers_for",
+    "lint_file", "register", "run_lint", "lint_repo", "baseline",
+    "HOT_FUNCTIONS", "HOT_PATH_FILES", "MAX_CLEAN_SYNCS",
+]
+
+
+def lint_repo(rules=None, root=None):
+    """Run the full (or rule-filtered) lint with baseline suppression
+    applied; returns the list of ACTIVE findings -- empty means the
+    tree is clean. The programmatic face used by ``bench.py --smoke``."""
+    from .core import REPO_ROOT
+    root = root or REPO_ROOT
+    checkers = checkers_for(rules) if rules else all_checkers()
+    result = run_lint(root=root, checkers=checkers)
+    result.findings, _ = baseline.apply_to(result.findings,
+                                           baseline.default_path(root))
+    return result.active

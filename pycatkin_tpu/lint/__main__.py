@@ -1,0 +1,7 @@
+"""``python -m pycatkin_tpu.lint`` == ``tools/pclint.py``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

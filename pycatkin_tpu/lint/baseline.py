@@ -1,0 +1,116 @@
+"""Suppression baselines: grandfathered findings committed to
+``lint_baseline.json`` so a new rule can land (and gate NEW code)
+without first rewriting every historical occurrence it flags.
+
+Fingerprints are content-addressed, NOT line-addressed: a finding is
+identified by (rule, file, normalized source line, occurrence index
+among identical lines), so unrelated edits that shift line numbers do
+not invalidate the baseline, while editing the flagged line itself --
+the moment a human touches it -- surfaces the finding for a real fix.
+
+Workflow:
+
+- ``python tools/pclint.py --update-baseline`` records every currently
+  active finding (reviewed in the same PR like any other diff);
+- a later run suppresses exactly those fingerprints (marked
+  ``baseline`` in reports) and fails on anything new;
+- entries whose code is gone are reported as stale so the file only
+  ever shrinks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Iterable, Optional
+
+from .core import Finding
+
+BASELINE_NAME = "lint_baseline.json"
+
+
+def default_path(root: str) -> str:
+    return os.path.join(root, BASELINE_NAME)
+
+
+def _normalize(source: str) -> str:
+    return re.sub(r"\s+", " ", source.strip())
+
+
+def fingerprints(findings: Iterable[Finding]) -> list[str]:
+    """Stable fingerprint per finding, order-aligned with the input.
+    Identical (rule, path, source) triples are disambiguated by their
+    lineno-ordered occurrence index."""
+    findings = list(findings)
+    groups: dict[tuple, list[Finding]] = defaultdict(list)
+    for f in findings:
+        groups[(f.rule, f.path, _normalize(f.source))].append(f)
+    fp = {}
+    for (rule, path, src), members in groups.items():
+        members.sort(key=lambda f: (f.lineno, f.col))
+        for k, f in enumerate(members):
+            digest = hashlib.sha1(
+                f"{rule}|{path}|{src}|{k}".encode()).hexdigest()[:16]
+            fp[id(f)] = digest
+    return [fp[id(f)] for f in findings]
+
+
+def load(path: str) -> dict:
+    """Baseline entries keyed by fingerprint ({} when absent)."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save(path: str, findings: Iterable[Finding]) -> int:
+    """Write the baseline for the given (active) findings; returns the
+    entry count. Entries are sorted for diff-stable output."""
+    findings = list(findings)
+    entries = [
+        {"fingerprint": fp, "rule": f.rule, "path": f.path,
+         "line": f.lineno, "source": _normalize(f.source),
+         "message": f.message}
+        for fp, f in zip(fingerprints(findings), findings)
+    ]
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    payload = {
+        "version": 1,
+        "tool": "pclint",
+        "note": ("Grandfathered findings. Regenerate with "
+                 "`python tools/pclint.py --update-baseline`; entries "
+                 "disappear automatically once the flagged line is "
+                 "fixed or removed."),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply(findings: Iterable[Finding],
+          entries: dict) -> tuple[list[Finding], list[dict]]:
+    """Mark baseline-suppressed findings in place. Returns
+    ``(findings, stale_entries)`` where stale entries matched nothing
+    (their code was fixed -- prune them from the file)."""
+    findings = list(findings)
+    matched: set[str] = set()
+    for fp, f in zip(fingerprints(findings), findings):
+        if f.suppressed is None and fp in entries:
+            f.suppressed = "baseline"
+            f.reason = "grandfathered in " + BASELINE_NAME
+            matched.add(fp)
+    stale = [e for fp, e in sorted(entries.items())
+             if fp not in matched]
+    return findings, stale
+
+
+def apply_to(findings: Iterable[Finding],
+             path: Optional[str]) -> tuple[list[Finding], list[dict]]:
+    """Convenience: load + apply (no-op on a missing file)."""
+    return apply(findings, load(path) if path else {})

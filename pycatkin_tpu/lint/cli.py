@@ -1,0 +1,99 @@
+"""pclint command line (``tools/pclint.py`` / ``make lint`` /
+``python -m pycatkin_tpu.lint``).
+
+Exit status: 0 when every finding is suppressed (inline or baseline),
+1 otherwise -- the CI contract. ``--update-baseline`` rewrites
+``lint_baseline.json`` from the current active findings and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import baseline as bl
+from . import report
+from .core import REPO_ROOT, all_checkers, checkers_for, run_lint
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pclint",
+        description=("AST-based static analysis for pycatkin_tpu: "
+                     "host-sync budget, fault-site registry, jit "
+                     "purity, tracer hygiene, dtype policy, env-var "
+                     "registry. See docs/static_analysis.md."))
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "package, tools, tests, examples and top-"
+                        "level entry scripts)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule IDs or names to run "
+                        "(e.g. PCL001,tracer-leak); default: all")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", dest="fmt",
+                   help="output format (default: text)")
+    p.add_argument("--root", default=REPO_ROOT,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: <root>/"
+                        f"{bl.BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report grandfathered "
+                        "findings as active)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current active "
+                        "findings and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rule IDs and exit")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also list suppressed findings (text format)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for c in all_checkers():
+            print(f"{c.rule}  {c.name:18s} {c.description}")
+        return 0
+
+    checkers = (checkers_for(args.rules.split(","))
+                if args.rules else all_checkers())
+    result = run_lint(root=args.root, checkers=checkers,
+                      paths=args.paths or None)
+
+    baseline_path = args.baseline or bl.default_path(args.root)
+    stale: list = []
+    if args.update_baseline:
+        n = bl.save(baseline_path, result.active)
+        print(f"pclint: baseline updated -- {n} grandfathered "
+              f"finding(s) written to {baseline_path}")
+        return 0
+    if not args.no_baseline:
+        # Partial runs (rule/path filtered) must not report unrelated
+        # baseline entries as stale.
+        full_run = not args.rules and not args.paths
+        result.findings, stale = bl.apply_to(result.findings,
+                                             baseline_path)
+        if not full_run:
+            stale = []
+
+    if args.fmt == "json":
+        print(report.to_json(result))
+    elif args.fmt == "sarif":
+        print(report.to_sarif(result, checkers))
+    else:
+        print(report.format_text(result,
+                                 verbose_suppressed=args.verbose))
+        for e in stale:
+            print(f"pclint: note: stale baseline entry "
+                  f"{e['fingerprint']} ({e['rule']} {e['path']}:"
+                  f"{e['line']}) no longer matches -- prune it with "
+                  f"--update-baseline")
+    return 1 if result.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

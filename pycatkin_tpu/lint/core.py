@@ -1,0 +1,314 @@
+"""pclint core: findings, the checker plugin registry, unified inline
+suppressions, and the package file-walker.
+
+Every correctness contract this repo enforces statically (host-sync
+budget, fault-site registry, jit purity, tracer hygiene, dtype policy,
+env-var registry) is one :class:`Checker` subclass with a stable rule
+ID (``PCL001``..); ``tools/pclint.py`` / ``make lint`` runs them all
+over the whole tree and fails on any unsuppressed finding.
+
+Suppression is unified across all rules:
+
+- inline: ``# pclint: disable=PCL003 -- <reason>`` on any line the
+  flagged node spans (``disable=all`` silences every rule; several
+  rules separate with commas);
+- baseline: a committed ``lint_baseline.json`` of grandfathered
+  findings (:mod:`pycatkin_tpu.lint.baseline`), so new rules can land
+  without rewriting history while NEW findings still fail the build;
+- ``PCL001`` additionally honors the legacy ``# sync-ok: <reason>``
+  annotation (the pre-pclint syntax, kept so reviewed hot-path
+  transfers need no churn).
+
+This module imports nothing from the rest of the package (and no JAX),
+so the linter stays importable and fast even when the tree under
+analysis is broken.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# repo root: pycatkin_tpu/lint/core.py -> pycatkin_tpu/lint -> package
+# -> repo.
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Scanned by default: the package, its tooling, tests and examples plus
+# the top-level entry scripts. Checkers narrow further via wants().
+DEFAULT_ROOTS = ("pycatkin_tpu", "tools", "tests", "examples")
+DEFAULT_TOP_FILES = ("bench.py", "bench_suite.py", "__graft_entry__.py")
+
+# Never walked: caches, VCS internals, and the seeded-violation fixture
+# corpus (tests/lint_fixtures) that exists to be flagged ON PURPOSE by
+# the fixture tests -- explicit file arguments still reach it.
+EXCLUDE_DIRS = frozenset({"__pycache__", ".git", ".jax_aot_cache",
+                          ".ipynb_checkpoints", "lint_fixtures"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pclint:\s*disable=(?P<rules>[A-Za-z0-9_,\s]+?)"
+    r"\s*(?:--\s*(?P<reason>.*))?$")
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str                 # repo-relative posix path
+    lineno: int
+    col: int
+    message: str
+    source: str = ""          # stripped source line (fingerprint input)
+    end_lineno: Optional[int] = None   # span end, for suppression match
+    suppressed: Optional[str] = None   # None | "inline" | "baseline"
+    reason: str = ""                   # suppression reason, if any
+
+    def location(self) -> str:
+        return f"{self.path}:{self.lineno}"
+
+
+@dataclass
+class LintResult:
+    """Everything one run produced: all findings (suppressed included)
+    plus scan bookkeeping for the reports."""
+
+    findings: list[Finding] = field(default_factory=list)
+    n_files: int = 0
+    rules: list[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed is None]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed is not None]
+
+
+class SourceFile:
+    """One parsed source file handed to every checker: text, lines,
+    lazily-built AST, and the per-line inline-suppression table."""
+
+    def __init__(self, path: str, relpath: str, text: Optional[str] = None):
+        self.path = path
+        self.relpath = relpath.replace("\\", "/")
+        if text is None:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._disable: Optional[dict] = None
+
+    @property
+    def tree(self) -> ast.AST:
+        if self._tree is None:
+            self._tree = ast.parse(self.text, filename=self.path)
+        return self._tree
+
+    def line(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def span_lines(self, lineno: int, end_lineno: Optional[int]):
+        """Source lines a node spans (multi-line calls suppress on ANY
+        of their lines)."""
+        return range(lineno, (end_lineno or lineno) + 1)
+
+    def _disables(self) -> dict:
+        if self._disable is None:
+            table = {}
+            for i, ln in enumerate(self.lines, 1):
+                m = _SUPPRESS_RE.search(ln)
+                if not m:
+                    continue
+                spec = m.group("rules").strip()
+                rules = (frozenset({"all"}) if spec.lower() == "all"
+                         else frozenset(r.strip().upper()
+                                        for r in spec.split(",")
+                                        if r.strip()))
+                table[i] = (rules, (m.group("reason") or "").strip())
+            self._disable = table
+        return self._disable
+
+    def disabled(self, rule: str, lineno: int,
+                 end_lineno: Optional[int] = None) -> Optional[str]:
+        """The suppression reason when ``rule`` is inline-disabled on
+        any line of the span, else None ('' when no reason given)."""
+        table = self._disables()
+        for i in self.span_lines(lineno, end_lineno):
+            hit = table.get(i)
+            if hit is not None:
+                rules, reason = hit
+                if "all" in rules or rule in rules:
+                    return reason
+        return None
+
+
+class Checker:
+    """Base class for one lint rule.
+
+    Subclasses set ``rule`` (stable ``PCLnnn`` ID), ``name`` (kebab
+    slug used in reports), ``description``, and ``scope`` (repo-relative
+    posix path prefixes the rule applies to), then implement
+    :meth:`check_file`. Register with :func:`register` so the runner
+    discovers them. ``self.root`` is set by the runner before any
+    :meth:`check_file` call (checkers that read docs resolve them
+    against it).
+    """
+
+    rule = "PCL000"
+    name = "base"
+    description = ""
+    scope: tuple = ("",)      # prefix "" = every scanned file
+
+    def __init__(self):
+        self.root = REPO_ROOT
+
+    def wants(self, relpath: str) -> bool:
+        relpath = relpath.replace("\\", "/")
+        return relpath.endswith(".py") and any(
+            relpath.startswith(prefix) for prefix in self.scope)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, src: SourceFile, node, message: str) -> Finding:
+        """Finding at an AST node, source line attached."""
+        lineno = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.rule, path=src.relpath, lineno=lineno,
+            col=getattr(node, "col_offset", 0), message=message,
+            source=src.line(lineno).strip(),
+            end_lineno=getattr(node, "end_lineno", None))
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator adding a Checker subclass to the runner's
+    registry (keyed by rule ID; re-registration replaces)."""
+    _REGISTRY[cls.rule] = cls
+    return cls
+
+
+def all_checkers() -> list[Checker]:
+    """Instances of every registered checker, rule-ID order. Imports
+    the built-in checker modules on first use so plain
+    ``import pycatkin_tpu.lint.core`` stays dependency-free."""
+    from . import (dtype, env_registry, fault_sites,  # noqa: F401
+                   host_sync, purity, tracer)
+    return [_REGISTRY[rule]() for rule in sorted(_REGISTRY)]
+
+
+def checkers_for(rules) -> list[Checker]:
+    """Checker instances for the given rule IDs or names (raises on an
+    unknown selector -- a typo must not silently lint nothing)."""
+    available = {c.rule: c for c in all_checkers()}
+    by_name = {c.name: c for c in available.values()}
+    picked = []
+    for sel in rules:
+        key = sel.strip()
+        c = available.get(key.upper()) or by_name.get(key.lower())
+        if c is None:
+            known = ", ".join(f"{c.rule}({c.name})"
+                              for c in available.values())
+            raise KeyError(f"unknown rule {sel!r}; known: {known}")
+        if c not in picked:
+            picked.append(c)
+    return picked
+
+
+def iter_source_paths(root: str, paths=None):
+    """(abspath, relpath) for every Python file to scan. ``paths``
+    (files or directories, absolute or root-relative) override the
+    default roots; explicitly named files bypass EXCLUDE_DIRS."""
+    if paths:
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isfile(ap):
+                yield ap, os.path.relpath(ap, root)
+            else:
+                yield from _walk_dir(ap, root)
+        return
+    for sub in DEFAULT_ROOTS:
+        yield from _walk_dir(os.path.join(root, sub), root)
+    for fname in DEFAULT_TOP_FILES:
+        ap = os.path.join(root, fname)
+        if os.path.isfile(ap):
+            yield ap, fname
+
+
+def _walk_dir(top: str, root: str):
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in EXCLUDE_DIRS)
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                ap = os.path.join(dirpath, fname)
+                yield ap, os.path.relpath(ap, root)
+
+
+def _apply_inline(src: SourceFile, findings: Iterable[Finding]):
+    """Mark findings the file inline-suppresses; yields every finding
+    (suppressed ones carry suppressed='inline' + the reason)."""
+    for f in findings:
+        reason = src.disabled(f.rule, f.lineno, f.end_lineno)
+        if reason is not None:
+            f.suppressed = "inline"
+            f.reason = reason
+        yield f
+
+
+def lint_file(checker: Checker, path: str, relpath: Optional[str] = None,
+              root: Optional[str] = None) -> list[Finding]:
+    """Run ONE checker over ONE file (fixture tests and the legacy
+    shim scripts use this; scope filtering is bypassed on purpose)."""
+    checker.root = root or REPO_ROOT
+    if relpath is None:
+        try:
+            relpath = os.path.relpath(path, checker.root)
+        except ValueError:            # different drive (windows)
+            relpath = os.path.basename(path)
+    src = SourceFile(path, relpath)
+    return list(_apply_inline(src, checker.check_file(src)))
+
+
+def run_lint(root: Optional[str] = None, checkers=None,
+             paths=None) -> LintResult:
+    """Walk the tree, run every (selected) checker on the files in its
+    scope, apply inline suppressions. Baseline suppression is applied
+    by the caller (:mod:`pycatkin_tpu.lint.cli`) so programmatic users
+    can inspect the raw findings."""
+    root = root or REPO_ROOT
+    if checkers is None:
+        checkers = all_checkers()
+    for c in checkers:
+        c.root = root
+    result = LintResult(rules=[c.rule for c in checkers])
+    for path, relpath in iter_source_paths(root, paths):
+        wanted = [c for c in checkers if c.wants(relpath)]
+        if not wanted:
+            continue
+        src = SourceFile(path, relpath)
+        result.n_files += 1
+        try:
+            src.tree
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                rule="PCL000", path=src.relpath,
+                lineno=e.lineno or 1, col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+                source=(e.text or "").strip()))
+            continue
+        for c in wanted:
+            result.findings.extend(
+                _apply_inline(src, c.check_file(src)))
+    result.findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    return result

@@ -1,0 +1,56 @@
+"""PCL005 dtype-discipline: no hardcoded float64 in the numerical
+kernels (``ops/``, ``solvers/``).
+
+The x64 policy is process-global and owned by the package root
+(``pycatkin_tpu/__init__`` enables ``jax_enable_x64`` unless
+``PYCATKIN_TPU_X64=0``; TPU-safe precomputed constants live in
+``constants.py``). A kernel that spells ``np.float64`` /
+``jnp.float64`` / ``dtype="float64"`` directly pins precision at one
+call site: under the TPU's emulated f64 (float32 exponent RANGE --
+see constants.py) or a deliberate x64-off run, that one site silently
+diverges from every other kernel, and stiff chemical ODE solves fail
+in the worst way -- plausible-looking wrong numbers. Inherit dtypes
+from the inputs, or derive them from the policy in one place.
+
+Host-side interop that genuinely needs a concrete f64 (e.g. handing
+numpy a deterministic scratch array) suppresses inline with a reason
+or lives in the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+_F64_BASES = frozenset({"np", "numpy", "jnp"})
+
+
+@register
+class DtypeChecker(Checker):
+    rule = "PCL005"
+    name = "dtype-discipline"
+    description = ("hardcoded float64 in a numerical kernel; inherit "
+                   "the dtype or route it through the x64 policy "
+                   "(constants.py / PYCATKIN_TPU_X64)")
+    scope = ("pycatkin_tpu/ops/", "pycatkin_tpu/solvers/")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "float64"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in _F64_BASES):
+                yield self.finding(
+                    src, node,
+                    f"hardcoded {node.value.id}.float64 in a "
+                    f"numerical kernel; inherit the dtype from the "
+                    f"inputs or derive it from the x64 policy")
+            elif (isinstance(node, ast.Constant)
+                    and node.value == "float64"):
+                yield self.finding(
+                    src, node,
+                    "bare \"float64\" dtype literal in a numerical "
+                    "kernel; inherit the dtype from the inputs or "
+                    "derive it from the x64 policy")

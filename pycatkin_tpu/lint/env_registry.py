@@ -1,0 +1,82 @@
+"""PCL006 env-registry: every ``PYCATKIN_*`` environment key referenced
+anywhere in the tree must appear in the documented env-var registry
+(docs/index.md, "Environment variable registry").
+
+The framework grew real knobs PR over PR (``PYCATKIN_FAULTS``,
+``PYCATKIN_VALIDATE``, ``PYCATKIN_AOT_CACHE``, ...). An env key read
+by code but absent from the registry is an undocumented production
+control: it changes behavior, nobody operating the system can discover
+it, and two PRs can invent colliding names. This rule closes the
+registry the same way PCL002 closes the fault-site registry.
+
+Detection is deliberately blunt: ANY string literal that full-matches
+``PYCATKIN_[A-Z0-9_]+`` counts as a reference -- ``os.environ.get``
+reads, ``os.environ[...]`` writes, monkeypatched test knobs, env
+pass-through lists. Blunt is right here: a key you set, forward, or
+test is a key an operator can set, so it belongs in the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, SourceFile, register
+
+DOC_RELPATH = os.path.join("docs", "index.md")
+
+_KEY_RE = re.compile(r"^PYCATKIN_[A-Z0-9_]+$")
+_DOC_KEY_RE = re.compile(r"`(PYCATKIN_[A-Z0-9_]+)`")
+
+
+def registered_keys(doc_path: str) -> set:
+    """Every backticked PYCATKIN_* token in the registry doc."""
+    with open(doc_path, encoding="utf-8") as fh:
+        return set(_DOC_KEY_RE.findall(fh.read()))
+
+
+@register
+class EnvRegistryChecker(Checker):
+    rule = "PCL006"
+    name = "env-registry"
+    description = ("PYCATKIN_* env key not in the documented registry "
+                   "(docs/index.md)")
+    scope = ("",)             # the whole scanned tree
+
+    def __init__(self, doc_path: Optional[str] = None):
+        super().__init__()
+        self._doc_path = doc_path
+        self._registered: Optional[set] = None
+
+    @property
+    def doc_path(self) -> str:
+        return self._doc_path or os.path.join(self.root, DOC_RELPATH)
+
+    def registered(self) -> set:
+        if self._registered is None:
+            self._registered = registered_keys(self.doc_path)
+        return self._registered
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        seen_lines: set[tuple] = set()
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _KEY_RE.match(node.value)):
+                continue
+            key = node.value
+            if key in self.registered():
+                continue
+            # One finding per (key, line): `K in os.environ` idioms can
+            # mention the same literal twice on a line.
+            dedup = (key, node.lineno)
+            if dedup in seen_lines:
+                continue
+            seen_lines.add(dedup)
+            yield self.finding(
+                src, node,
+                f"environment key `{key}` is not in the documented "
+                f"registry -- add a row to docs/index.md "
+                f"\"Environment variable registry\"")

@@ -1,0 +1,145 @@
+"""PCL002 fault-sites: every fault-site label is documented in
+docs/failure_model.md.
+
+The failure subsystem addresses faults by dispatch-site label (the
+``label=`` strings of ``call_with_backend_retry`` /
+``run_chunk_with_ladder`` / ``record_event`` / ``record_quarantine``,
+the label argument of ``timed_retry``, and ``site = ...``
+assignments). A label in code but not in the doc is an undocumented
+failure branch: a fault plan targeting it works, but nobody reading
+the failure model knows it exists.
+
+F-string labels are normalized by replacing each interpolated field
+with ``<i>`` (consecutive fields collapse, so ``f"rescue[{a}{b}]"``
+and ``f"rescue[{s}]"`` both become ``rescue[<i>]``); dynamic labels
+cannot be statically checked and are skipped. A normalized label must
+appear backticked in the doc.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, SourceFile, register
+
+DOC_RELPATH = os.path.join("docs", "failure_model.md")
+
+# Only these callees take fault-site labels; collecting every `label=`
+# kwarg would false-positive on matplotlib legend labels.
+LABEL_FUNCS = frozenset({"call_with_backend_retry",
+                         "run_chunk_with_ladder", "record_event",
+                         "record_quarantine", "timed_retry"})
+SITE_NAMES = frozenset({"site", "_site"})
+
+
+def normalize(node) -> Optional[str]:
+    """Literal or f-string label -> normalized site string (None for
+    dynamic expressions)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("<i>")
+        return re.sub(r"(<i>)+", "<i>", "".join(parts))
+    return None
+
+
+class SiteCollector(ast.NodeVisitor):
+    """Collect (normalized_label, node) pairs from one module."""
+
+    def __init__(self):
+        self.sites: list[tuple[str, ast.AST]] = []
+
+    def _add(self, node, value):
+        label = normalize(value)
+        if label is not None:
+            self.sites.append((label, node))
+
+    def visit_Call(self, node):
+        func = node.func
+        fname = getattr(func, "id", None) or getattr(func, "attr", "")
+        if fname in LABEL_FUNCS:
+            for kw in node.keywords:
+                if kw.arg == "label":
+                    self._add(node, kw.value)
+            if fname == "timed_retry" and len(node.args) >= 2:
+                self._add(node, node.args[1])
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        if any(isinstance(t, ast.Name) and t.id in SITE_NAMES
+               for t in node.targets):
+            self._add(node, node.value)
+        self.generic_visit(node)
+
+
+def documented_labels(doc_path: str) -> set:
+    """Every backticked token in the failure-model doc."""
+    with open(doc_path, encoding="utf-8") as fh:
+        return set(re.findall(r"`([^`\n]+)`", fh.read()))
+
+
+@register
+class FaultSiteChecker(Checker):
+    rule = "PCL002"
+    name = "fault-sites"
+    description = ("fault-site label not documented in "
+                   "docs/failure_model.md")
+    scope = ("pycatkin_tpu/",)
+
+    def __init__(self, doc_path: Optional[str] = None):
+        super().__init__()
+        self._doc_path = doc_path
+        self._documented: Optional[set] = None
+
+    @property
+    def doc_path(self) -> str:
+        return self._doc_path or os.path.join(self.root, DOC_RELPATH)
+
+    def documented(self) -> set:
+        if self._documented is None:
+            self._documented = documented_labels(self.doc_path)
+        return self._documented
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        collector = SiteCollector()
+        collector.visit(src.tree)
+        if not collector.sites:
+            return
+        documented = self.documented()
+        rel_doc = DOC_RELPATH.replace(os.sep, "/")
+        for label, node in collector.sites:
+            if label in documented:
+                continue
+            yield self.finding(
+                src, node,
+                f"undocumented fault-site label `{label}` -- add it, "
+                f"backticked, to {rel_doc}")
+
+
+def collect_sites(package: str, rel_to: Optional[str] = None):
+    """Legacy-shaped entry for ``tools/lint_fault_sites.py``: every
+    statically-known fault-site label under ``package`` as sorted
+    (label, relpath, lineno) triples."""
+    rel_to = rel_to or os.path.dirname(package)
+    found = []
+    for dirpath, dirnames, filenames in os.walk(package):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            src = SourceFile(path, os.path.relpath(path, rel_to))
+            collector = SiteCollector()
+            collector.visit(src.tree)
+            rel = os.path.relpath(path, rel_to)
+            found += [(label, rel, node.lineno)
+                      for label, node in collector.sites]
+    return sorted(found)

@@ -1,0 +1,137 @@
+"""PCL001 host-sync: no uncounted blocking device->host
+materializations on the sweep hot path.
+
+On the tunneled production backend each blocking materialization costs
+~0.8-1.2 s of round trip regardless of payload (docs/index.md
+"Performance"), so every intentional hot-path transfer must flow
+through ``utils.profiling.host_sync`` -- the counted choke point
+``tests/test_sync_budget.py`` holds to the contractual budget -- or
+carry a reviewed ``# sync-ok: <reason>`` annotation.
+
+The checker walks the files of the hot-path registry
+(:mod:`pycatkin_tpu.lint.hotpath` -- ONE list shared with the budget
+test) and flags, inside registered functions only (nested closures
+included: they run on the hot path), the two raw idioms that history
+shows creep in during refactors:
+
+- ``np.asarray(...)`` (blocking copy of a device array)
+- ``int(jnp....)`` / ``float(jnp....)`` (scalar pull of a device
+  value) -- positional OR keyword arguments (the pre-pclint script
+  only inspected ``args[0]``)
+
+``# sync-ok:`` is honored on ANY line a multi-line call spans (the
+pre-pclint script only matched the call's first line), as is the
+unified ``# pclint: disable=PCL001`` syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, SourceFile, register
+from .hotpath import HOT_FUNCTIONS, SYNC_ANNOTATION, hot_functions_for
+
+
+def _is_np_asarray(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "asarray"
+            and isinstance(f.value, ast.Name) and f.value.id == "np")
+
+
+def _mentions_jnp(expr: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id == "jnp"
+               for sub in ast.walk(expr))
+
+
+def _is_scalar_pull(node: ast.Call) -> bool:
+    """``int(...)``/``float(...)`` whose argument expression mentions
+    jnp -- a device scalar pulled to the host. Inspects every
+    positional AND keyword argument; ``int(host_sync(...))`` is the
+    counted idiom, not a bypass."""
+    f = node.func
+    if not (isinstance(f, ast.Name) and f.id in ("int", "float")):
+        return False
+    exprs = list(node.args) + [kw.value for kw in node.keywords]
+    if not exprs:
+        return False
+    for arg in exprs:
+        if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                and arg.func.id == "host_sync"):
+            return False
+    return any(_mentions_jnp(arg) for arg in exprs)
+
+
+def _annotated(src: SourceFile, node: ast.AST) -> bool:
+    """True when any line the node spans carries the legacy
+    ``# sync-ok:`` annotation."""
+    return any(SYNC_ANNOTATION in src.line(i)
+               for i in src.span_lines(node.lineno,
+                                       getattr(node, "end_lineno", None)))
+
+
+@register
+class HostSyncChecker(Checker):
+    rule = "PCL001"
+    name = "host-sync"
+    description = ("raw device->host materialization on the sweep hot "
+                   "path; route through utils.profiling.host_sync or "
+                   "annotate '# sync-ok: <reason>'")
+
+    def __init__(self, hot_paths: Optional[dict] = None):
+        super().__init__()
+        # relpath -> hot-function set; None = the shared registry.
+        self.hot_paths = hot_paths
+
+    def wants(self, relpath: str) -> bool:
+        if self.hot_paths is not None:
+            return relpath.replace("\\", "/") in self.hot_paths
+        return hot_functions_for(relpath) is not None
+
+    def _functions_for(self, relpath: str):
+        if self.hot_paths is not None:
+            hit = self.hot_paths.get(relpath.replace("\\", "/"))
+        else:
+            hit = hot_functions_for(relpath)
+        # Direct lint_file() calls on fixture copies fall back to the
+        # full registered-name union.
+        return hit if hit is not None else HOT_FUNCTIONS
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        hot = self._functions_for(src.relpath)
+        for top in src.tree.body:
+            if not isinstance(top, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                continue
+            if top.name not in hot:
+                continue
+            for node in ast.walk(top):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not (_is_np_asarray(node) or _is_scalar_pull(node)):
+                    continue
+                if _annotated(src, node):
+                    continue
+                kind = ("np.asarray" if _is_np_asarray(node)
+                        else "int()/float() scalar pull")
+                yield self.finding(
+                    src, node,
+                    f"uncounted host materialization ({kind}) in hot-"
+                    f"path function `{top.name}`; route through "
+                    f"utils.profiling.host_sync or annotate "
+                    f"'{SYNC_ANNOTATION} <reason>'")
+
+
+def collect_syncs(path: str, hot_functions=None):
+    """Legacy-shaped entry for ``tools/lint_host_syncs.py``:
+    ``(lineno, stripped source line)`` of every unannotated raw
+    materialization inside a hot function of ``path``."""
+    hot = frozenset(hot_functions) if hot_functions is not None \
+        else HOT_FUNCTIONS
+    import os
+    rel = os.path.basename(path)
+    checker = HostSyncChecker(hot_paths={rel: hot})
+    src = SourceFile(path, rel)
+    flagged = [(f.lineno, f.source) for f in checker.check_file(src)
+               if src.disabled(f.rule, f.lineno, f.end_lineno) is None]
+    return sorted(set(flagged))

@@ -1,0 +1,48 @@
+"""The sweep hot-path registry: ONE place naming the functions whose
+host-sync behavior is contractual.
+
+Two enforcement mechanisms consume this module and must agree exactly:
+
+- ``tests/test_sync_budget.py`` holds a clean sweep to
+  :data:`MAX_CLEAN_SYNCS` counted materializations at runtime;
+- the ``PCL001`` host-sync checker (:mod:`pycatkin_tpu.lint.host_sync`,
+  ``make lint``) statically flags raw materialization idioms inside the
+  registered functions.
+
+Before this module existed the function list lived twice (the lint
+script and the budget test) and could silently drift: a function added
+to the hot path but only one list would be half-enforced. Add new
+hot-path files/functions HERE, nowhere else.
+"""
+
+from __future__ import annotations
+
+# A clean (zero-failure) sweep_steady_state may spend at most this many
+# counted blocking device->host materializations (the ISSUE-3 budget;
+# the implementation spends 2: solve fence + packed tail bundle).
+MAX_CLEAN_SYNCS = 3
+
+# Inline annotation marking a reviewed failure-path transfer. Honored on
+# ANY line of a multi-line call (the pre-pclint lint only matched the
+# call's first line).
+SYNC_ANNOTATION = "# sync-ok:"
+
+# The sweep hot path: functions a clean (zero-failure) sweep executes,
+# plus the failure-path functions whose syncs must stay labeled.
+HOT_FUNCTIONS = frozenset({
+    "batch_steady_state", "sweep_steady_state", "_finish_sweep",
+    "_rescue", "_quarantine_mask", "stability_mask",
+    "continuation_sweep",
+})
+
+# file (posix path relative to the repo root) -> hot function names.
+# The PCL001 checker scans exactly these files.
+HOT_PATH_FILES: dict[str, frozenset[str]] = {
+    "pycatkin_tpu/parallel/batch.py": HOT_FUNCTIONS,
+}
+
+
+def hot_functions_for(relpath: str):
+    """Hot-function set for a repo-relative posix path (None when the
+    file carries no hot-path contract)."""
+    return HOT_PATH_FILES.get(relpath.replace("\\", "/"))

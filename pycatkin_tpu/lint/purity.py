@@ -1,0 +1,170 @@
+"""PCL003 jit-purity: no side effects inside jitted functions.
+
+``jax.jit`` traces a function ONCE per (shapes, dtypes) signature and
+replays the compiled XLA program thereafter: any Python side effect in
+the body -- ``print``, reading ``os.environ``, Python/NumPy RNG,
+wall-clock reads, ``global`` mutation -- executes at trace time only,
+then silently never again. In stiff-kinetics kernels this is how
+"debug prints that stopped printing" and "env knobs that stopped
+knobbing" bugs are born; SPIN-ODE-style solver stacks treat trace
+purity as a hard contract, and so do we.
+
+Statically-detected jitted functions:
+
+- decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+  ``@partial(jax.jit, ...)``;
+- any function whose NAME is passed (possibly nested under ``vmap``
+  etc.) to a ``jax.jit(...)`` / ``pjit(...)`` call in the same module
+  -- the repo's dominant ``return jax.jit(jax.vmap(solve_one))``
+  closure-factory idiom.
+
+Flagged inside those bodies (nested closures included -- they trace
+too): ``print(...)``, ``os.environ`` / ``os.getenv`` reads, ``random.*``
+and ``np.random.*`` calls, ``time.time``-family and ``datetime.now``
+reads, and ``global`` declarations. ``jax.debug.print`` and
+``jax.random`` are the blessed alternatives and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from .core import Checker, Finding, SourceFile, register
+
+JIT_NAMES = frozenset({"jit", "pjit"})
+
+_TIME_READS = frozenset({"time.time", "time.perf_counter",
+                         "time.monotonic", "time.process_time",
+                         "datetime.now", "datetime.utcnow",
+                         "datetime.datetime.now",
+                         "datetime.datetime.utcnow"})
+
+
+def dotted(expr) -> str:
+    """``a.b.c`` for an attribute chain ('' when not a plain chain).
+    A leading-underscore alias of a module (``_os``, ``_time``) is
+    normalized to the bare name -- the repo imports modules that way
+    to keep them out of the public namespace."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id.lstrip("_") or expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(expr) -> bool:
+    """True for a `jit`/`pjit` reference (bare name or attribute)."""
+    if isinstance(expr, ast.Name):
+        return expr.id in JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in JIT_NAMES
+    return False
+
+
+def _is_jit_decorator(deco) -> bool:
+    if _is_jit_expr(deco):
+        return True
+    if isinstance(deco, ast.Call):
+        if _is_jit_expr(deco.func):
+            return True
+        # functools.partial(jax.jit, static_argnums=...)
+        fname = dotted(deco.func)
+        if fname.endswith("partial"):
+            return any(_is_jit_expr(a) for a in deco.args)
+    return False
+
+
+def iter_jitted_functions(tree) -> Iterator[ast.FunctionDef]:
+    """Every function def in the module that is statically known to be
+    jitted (decorator form, or its name appears inside the positional
+    arguments of a jit call anywhere in the module)."""
+    jitted_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        jitted_names.add(sub.id)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if (node.name in jitted_names
+                or any(_is_jit_decorator(d)
+                       for d in node.decorator_list)):
+            yield node
+
+
+@register
+class JitPurityChecker(Checker):
+    rule = "PCL003"
+    name = "jit-purity"
+    description = ("Python side effect inside a jitted function "
+                   "(runs at trace time only, then silently never "
+                   "again)")
+    scope = ("pycatkin_tpu/",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in iter_jitted_functions(src.tree):
+            yield from self._check_body(src, fn)
+
+    def _check_body(self, src: SourceFile, fn) -> Iterable[Finding]:
+        where = f"inside jitted function `{fn.name}`"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                yield self.finding(
+                    src, node,
+                    f"`global {', '.join(node.names)}` {where}: "
+                    f"mutating module state under trace happens once, "
+                    f"then never again")
+                continue
+            if isinstance(node, ast.Subscript):
+                if dotted(node.value) == "os.environ":
+                    yield self.finding(
+                        src, node,
+                        f"os.environ read {where}: the value is baked "
+                        f"in at trace time; read it outside and close "
+                        f"over the result")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                yield self.finding(
+                    src, node,
+                    f"print() {where}: prints once at trace time, "
+                    f"then silently never again; use "
+                    f"jax.debug.print for traced values")
+                continue
+            name = dotted(f)
+            if not name:
+                continue
+            if name.startswith("os.environ") or name == "os.getenv":
+                yield self.finding(
+                    src, node,
+                    f"environment read ({name}) {where}: baked in at "
+                    f"trace time; read it outside and close over the "
+                    f"result")
+            elif name.startswith("np.random.") \
+                    or name.startswith("numpy.random."):
+                yield self.finding(
+                    src, node,
+                    f"NumPy RNG ({name}) {where}: draws once at trace "
+                    f"time and the compiled program replays the same "
+                    f"constants; thread a jax.random key instead")
+            elif name.startswith("random."):
+                yield self.finding(
+                    src, node,
+                    f"Python RNG ({name}) {where}: draws once at "
+                    f"trace time and the compiled program replays the "
+                    f"same constants; thread a jax.random key instead")
+            elif name in _TIME_READS:
+                yield self.finding(
+                    src, node,
+                    f"wall-clock read ({name}) {where}: the timestamp "
+                    f"is a trace-time constant; time around the "
+                    f"jitted call, not inside it")

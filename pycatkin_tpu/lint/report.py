@@ -1,0 +1,120 @@
+"""Finding renderers: human text, machine JSON, and SARIF 2.1.0 (the
+interchange format CI annotation UIs ingest). One runner, three
+faces -- checkers never format anything themselves."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Iterable
+
+from .core import Checker, Finding, LintResult
+
+
+def _counts(findings: Iterable[Finding]) -> Counter:
+    return Counter(f.rule for f in findings)
+
+
+def format_text(result: LintResult, verbose_suppressed: bool = False) -> str:
+    """The ``make lint`` face: one line per active finding plus a
+    summary; suppressed findings are summarized (listed with -v)."""
+    out = []
+    active = result.active
+    for f in active:
+        out.append(f"{f.location()}: {f.rule} {f.message}")
+        if f.source:
+            out.append(f"    {f.source}")
+    if verbose_suppressed:
+        for f in result.suppressed:
+            why = f.suppressed + (f" ({f.reason})" if f.reason else "")
+            out.append(f"{f.location()}: {f.rule} [suppressed: {why}] "
+                       f"{f.message}")
+    n_inline = sum(1 for f in result.suppressed
+                   if f.suppressed == "inline")
+    n_base = sum(1 for f in result.suppressed
+                 if f.suppressed == "baseline")
+    if active:
+        per_rule = ", ".join(f"{r}={n}"
+                             for r, n in sorted(_counts(active).items()))
+        out.append(
+            f"pclint: FAIL -- {len(active)} finding(s) [{per_rule}] in "
+            f"{result.n_files} file(s); {n_inline} inline / {n_base} "
+            f"baseline suppression(s). Fix, annotate '# pclint: "
+            f"disable=<rule> -- <reason>', or (for legacy code) "
+            f"re-baseline. See docs/static_analysis.md.")
+    else:
+        out.append(
+            f"pclint: OK -- {result.n_files} file(s), rules "
+            f"{','.join(result.rules)}; 0 findings ({n_inline} inline, "
+            f"{n_base} baseline suppression(s))")
+    return "\n".join(out)
+
+
+def to_json(result: LintResult) -> str:
+    """Machine face: every finding (suppressed included, labeled) plus
+    the summary block, one JSON document."""
+    doc = {
+        "tool": "pclint",
+        "files_scanned": result.n_files,
+        "rules": result.rules,
+        "counts": {
+            "active": len(result.active),
+            "suppressed_inline": sum(
+                1 for f in result.suppressed if f.suppressed == "inline"),
+            "suppressed_baseline": sum(
+                1 for f in result.suppressed
+                if f.suppressed == "baseline"),
+        },
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.lineno,
+             "col": f.col, "message": f.message, "source": f.source,
+             "suppressed": f.suppressed, "reason": f.reason or None}
+            for f in result.findings
+        ],
+    }
+    return json.dumps(doc, indent=2)
+
+
+def to_sarif(result: LintResult, checkers: Iterable[Checker]) -> str:
+    """Minimal SARIF 2.1.0 log (active findings only; suppressed ones
+    ride along in the SARIF ``suppressions`` field)."""
+    rules_meta = [
+        {"id": c.rule, "name": c.name,
+         "shortDescription": {"text": c.description or c.name}}
+        for c in checkers
+    ]
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.lineno,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.suppressed is not None:
+            kind = ("inSource" if f.suppressed == "inline"
+                    else "external")
+            entry["suppressions"] = [{
+                "kind": kind,
+                "justification": f.reason or f.suppressed,
+            }]
+        results.append(entry)
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "pclint",
+                                "informationUri":
+                                    "docs/static_analysis.md",
+                                "rules": rules_meta}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,159 @@
+"""PCL004 tracer-leak: no Python control flow or NumPy host calls on
+traced values inside jitted functions.
+
+Under ``jax.jit`` every array is a tracer. ``if``/``while``/``bool()``
+on a traced expression raises ``TracerBoolConversionError`` -- but
+only when that code path first traces, which for rescue-ladder /
+failure-path branches can be deep into a production sweep.
+``np.*`` calls on traced values either crash the trace
+(``TracerArrayConversionError``) or, worse, silently constant-fold a
+trace-time value into the compiled program -- the exact class of
+silent wrongness that wrecks stiff chemical ODE solves. This checker
+moves the detection to lint time.
+
+Flagged inside statically-detected jitted functions (same detection as
+PCL003, nested closures included):
+
+- ``if <expr>`` / ``while <expr>`` where the test mentions ``jnp``
+  (identity tests like ``x0 is None`` are static under jit and
+  exempt);
+- ``bool(<expr>)`` on a jnp expression or traced local;
+- ``np.*``/``numpy.*`` calls whose arguments mention ``jnp``, a
+  parameter of the jitted function, or a local derived from either
+  (one-pass taint propagation through simple assignments).
+
+Use ``jnp.where`` / ``lax.cond`` / ``lax.while_loop`` for traced
+control flow, and ``jnp.*`` for math on traced values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+from .purity import dotted, iter_jitted_functions
+
+
+def _mentions(expr, names: set) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(expr))
+
+
+def _param_names(fn) -> set:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _traced_names(fn) -> set:
+    """Parameters of the jitted function plus locals assigned from
+    expressions that mention jnp or an already-traced name -- a cheap
+    forward taint pass, iterated to a fixpoint (loops/reassignments
+    converge in <= a few passes; the walk order is lexical)."""
+    traced = _param_names(fn) | {"jnp"}
+    for _ in range(4):
+        before = len(traced)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                targets = [node.target] if node.value is not None else []
+            else:
+                continue
+            if value is None or not _mentions(value, traced):
+                continue
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        traced.add(sub.id)
+        if len(traced) == before:
+            break
+    return traced
+
+
+def _is_static_test(test) -> bool:
+    """`x is None` / `x is not None` style tests are resolved at trace
+    time (None is not a tracer) and are legal under jit."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+# jnp predicates over dtypes/shapes, not values: their results are
+# trace-time Python constants, so branching on them is legal under jit
+# (e.g. profiling._fence_arrays branches per-leaf on
+# jnp.issubdtype(x.dtype, jnp.floating)).
+_STATIC_JNP_CALLS = frozenset({
+    "jnp.issubdtype", "jnp.isdtype", "jnp.result_type",
+    "jnp.promote_types", "jnp.ndim", "jnp.shape", "jnp.size",
+})
+
+
+def _mentions_traced_jnp(expr) -> bool:
+    """True when `jnp` appears in the expression OUTSIDE calls to the
+    static (dtype/shape-level) predicates above."""
+    if (isinstance(expr, ast.Call)
+            and dotted(expr.func) in _STATIC_JNP_CALLS):
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id == "jnp"
+    return any(_mentions_traced_jnp(child)
+               for child in ast.iter_child_nodes(expr))
+
+
+@register
+class TracerLeakChecker(Checker):
+    rule = "PCL004"
+    name = "tracer-leak"
+    description = ("Python control flow or np.* host call on a traced "
+                   "value inside a jitted function (compile-time "
+                   "TracerBoolConversionError / silent constant-fold)")
+    scope = ("pycatkin_tpu/",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for fn in iter_jitted_functions(src.tree):
+            yield from self._check_body(src, fn)
+
+    def _check_body(self, src: SourceFile, fn) -> Iterable[Finding]:
+        where = f"inside jitted function `{fn.name}`"
+        traced = _traced_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                if (_mentions_traced_jnp(node.test)
+                        and not _is_static_test(node.test)):
+                    yield self.finding(
+                        src, node,
+                        f"Python `{kw}` on a jnp expression {where}: "
+                        f"raises TracerBoolConversionError at trace "
+                        f"time; use jnp.where / lax.cond / "
+                        f"lax.while_loop")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "bool":
+                exprs = list(node.args) + [k.value for k in node.keywords]
+                if any(_mentions(e, traced) for e in exprs):
+                    yield self.finding(
+                        src, node,
+                        f"bool() on a traced value {where}: raises "
+                        f"TracerBoolConversionError at trace time")
+                continue
+            name = dotted(f)
+            if not (name.startswith("np.")
+                    or name.startswith("numpy.")):
+                continue
+            exprs = list(node.args) + [k.value for k in node.keywords]
+            if any(_mentions(e, traced) for e in exprs):
+                yield self.finding(
+                    src, node,
+                    f"{name}() on a traced value {where}: NumPy "
+                    f"cannot consume tracers (crash or silent trace-"
+                    f"time constant-fold); use the jnp equivalent")

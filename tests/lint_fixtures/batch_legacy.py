@@ -1,0 +1,33 @@
+"""PCL003/PCL004 fixture: the `_tof_program` closure factory from
+pycatkin_tpu/parallel/batch.py with the historical host-side idioms
+reintroduced on purpose.
+
+`batched` is never decorated -- it is jitted by NAME via the package's
+dominant ``return jax.jit(batched)`` factory idiom, which is exactly
+what the static jit detection must see through. The seeded idioms are
+the real ones the hot path once carried: a debug ``print`` under
+trace, a Python ``if`` on a jnp reduction (TracerBoolConversionError,
+but only when the branch first traces), and an ``np.asarray`` of a
+traced local (silent trace-time constant-fold). Never executed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tof_program(spec, engine):
+    def batched(conds, ys, mask, ok):
+        tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
+                                                                   ys)
+        print("tof trace:", tofs)               # VIOLATION PCL003
+        print("lanes:", len(ys))  # pclint: disable=PCL003 -- trace-time shape log, intentional
+        act = engine.activity_from_tof(
+            tofs, jax.tree_util.tree_leaves(conds.T)[0])
+        lane_ok = ok & jnp.isfinite(tofs)
+        if jnp.any(lane_ok & (tofs < 0.0)):     # VIOLATION PCL004 (if)
+            act = -act
+        tof_host = np.asarray(tofs)             # VIOLATION PCL004 (np.*)
+        ok_host = np.asarray(ok)  # pclint: disable=PCL004 -- fixture: pretend ok is static
+        return tofs, act, tof_host, ok_host
+    return jax.jit(batched)

@@ -1,0 +1,17 @@
+"""PCL005 fixture: hardcoded float64 in kernel-style code.
+
+The checker's scope is ops/ and solvers/; the fixture test calls it
+directly via ``core.lint_file`` (which bypasses scope on purpose).
+Never executed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_scratch(n):
+    bad_attr = np.zeros(n, dtype=np.float64)        # VIOLATION (attr)
+    bad_str = jnp.asarray(bad_attr, dtype="float64")  # VIOLATION (str)
+    golden = np.zeros(n, dtype=np.float64)  # pclint: disable=PCL005 -- host-side golden buffer
+    inherited = jnp.zeros_like(bad_str)             # fine: inherits
+    return bad_attr, bad_str, golden, inherited

@@ -1,0 +1,15 @@
+"""PCL006 fixture: PYCATKIN_* keys in and out of the registry.
+
+`PYCATKIN_FAULTS` is in the documented registry (docs/index.md) and
+must stay silent; the fixture-only key must be flagged; the inline
+disable must suppress. Never executed.
+"""
+
+import os
+
+
+def knobs():
+    undocumented = os.environ.get("PYCATKIN_FIXTURE_ONLY_KNOB", "0")  # VIOLATION
+    documented = os.environ.get("PYCATKIN_FAULTS", "")
+    silenced = os.environ.get("PYCATKIN_FIXTURE_SILENCED")  # pclint: disable=PCL006 -- fixture key, not a knob
+    return undocumented, documented, silenced

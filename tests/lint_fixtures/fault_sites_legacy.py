@@ -1,0 +1,18 @@
+"""PCL002 fixture: fault-site labels, documented and not.
+
+tests/test_pclint.py runs the checker against a temporary doc that
+backticks only `fixture:documented`, so `fixture:undocumented` and the
+normalized f-string label `fixture:rescue[<i>]` must be flagged while
+the documented and inline-disabled sites stay silent. Never executed.
+"""
+
+from pycatkin_tpu.utils.profiling import record_event
+from pycatkin_tpu.utils.retry import call_with_backend_retry
+
+
+def run_with_sites(fn, lane):
+    site = "fixture:undocumented"                        # VIOLATION
+    record_event("degradation", label=f"fixture:rescue[{lane}]")  # VIOLATION
+    out = call_with_backend_retry(fn, label="fixture:documented")
+    record_event("degradation", label="fixture:reviewed")  # pclint: disable=PCL002 -- fixture-only site
+    return site, out

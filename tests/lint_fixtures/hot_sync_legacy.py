@@ -1,0 +1,31 @@
+"""PCL001 fixture: raw host materializations in a hot-path function.
+
+`sweep_steady_state` is a registered hot-path name
+(pycatkin_tpu/lint/hotpath.py); `cold_helper` is not and must stay
+silent. The multi-line `# sync-ok:` call and the keyword-argument
+scalar pull are regression proofs for the two misses of the
+pre-pclint script (first-line-only annotation match, args[0]-only
+pull detection). Never executed -- it only needs to parse.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from pycatkin_tpu.utils.profiling import host_sync
+
+
+def sweep_steady_state(spec, conds):
+    resid = jnp.ones(4)
+    out = np.asarray(resid)                 # VIOLATION: raw np.asarray
+    worst = float(x=jnp.max(resid))         # VIOLATION: keyword-arg pull
+    ok = np.asarray(
+        resid
+        > 0.0)  # sync-ok: failure path, full mask needed
+    n_bad = int(jnp.sum(resid < 0.0))  # pclint: disable=PCL001 -- reviewed diagnostics pull
+    counted = float(host_sync(jnp.min(resid), "fixture"))
+    return out, worst, ok, n_bad, counted
+
+
+def cold_helper(resid):
+    # Not a registered hot function: raw pulls here are legal.
+    return np.asarray(resid), float(jnp.max(resid))

@@ -1,0 +1,324 @@
+"""The pclint framework: per-rule fixture proofs (detect /
+inline-suppress / baseline-suppress), the two regression fixes the
+PCL001 migration shipped (multi-line ``# sync-ok:``, keyword-argument
+scalar pulls), registry consistency, and the repo-tree gate itself
+(``make lint`` must exit 0 on the current checkout).
+
+The seeded-violation corpus lives in tests/lint_fixtures/ -- excluded
+from the default walk (core.EXCLUDE_DIRS) precisely so it can stay
+red while the tree stays green; tests reach it via ``core.lint_file``
+which bypasses scope filtering on purpose.
+
+NOTE: PCL006 scans this test file too, so env-key literals below are
+spelled as concatenations ("PYCATKIN_" + ...) to stay out of the
+checker's full-match regex.
+"""
+
+import ast
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from pycatkin_tpu.lint import baseline
+from pycatkin_tpu.lint import core
+from pycatkin_tpu.lint.core import Finding, checkers_for, lint_file, run_lint
+from pycatkin_tpu.lint.dtype import DtypeChecker
+from pycatkin_tpu.lint.env_registry import EnvRegistryChecker
+from pycatkin_tpu.lint.fault_sites import FaultSiteChecker
+from pycatkin_tpu.lint.host_sync import HostSyncChecker, collect_syncs
+from pycatkin_tpu.lint.hotpath import (HOT_FUNCTIONS, HOT_PATH_FILES,
+                                       MAX_CLEAN_SYNCS)
+from pycatkin_tpu.lint.purity import JitPurityChecker
+from pycatkin_tpu.lint.tracer import TracerLeakChecker
+
+REPO = core.REPO_ROOT
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def active(findings):
+    return [f for f in findings if f.suppressed is None]
+
+
+def inline(findings):
+    return [f for f in findings if f.suppressed == "inline"]
+
+
+def _fault_checker(tmp_path):
+    """PCL002 against a doc documenting only `fixture:documented`."""
+    doc = tmp_path / "failure_model.md"
+    doc.write_text("Known sites: `fixture:documented`.\n",
+                   encoding="utf-8")
+    return FaultSiteChecker(doc_path=str(doc))
+
+
+# ---------------------------------------------------------------- PCL001
+
+def test_hot_sync_fixture_detects_and_suppresses():
+    findings = lint_file(HostSyncChecker(), fx("hot_sync_legacy.py"))
+    act = active(findings)
+    assert len(act) == 2, [f.message for f in act]
+    kinds = sorted(f.message for f in act)
+    assert any("np.asarray" in m for m in kinds)
+    assert any("scalar pull" in m for m in kinds)
+    # the `# pclint: disable=PCL001` pull is reported but suppressed
+    sup = inline(findings)
+    assert len(sup) == 1 and "diagnostics pull" in sup[0].reason
+    # nothing leaks out of the hot function into cold_helper
+    tree = ast.parse(open(fx("hot_sync_legacy.py")).read())
+    cold = next(n for n in tree.body
+                if isinstance(n, ast.FunctionDef)
+                and n.name == "cold_helper")
+    assert all(not (cold.lineno <= f.lineno <= cold.end_lineno)
+               for f in findings)
+
+
+def test_sync_ok_honored_on_continuation_line():
+    """Regression (satellite fix): the pre-pclint script only matched
+    `# sync-ok:` on the call's FIRST line; the fixture's multi-line
+    np.asarray carries it on the last line and must be silent."""
+    src = open(fx("hot_sync_legacy.py")).read().splitlines()
+    annotated_line = next(i for i, ln in enumerate(src, 1)
+                          if "# sync-ok:" in ln)
+    findings = lint_file(HostSyncChecker(), fx("hot_sync_legacy.py"))
+    span = range(annotated_line - 2, annotated_line + 1)
+    assert all(f.lineno not in span for f in findings)
+
+
+def test_keyword_scalar_pull_detected():
+    """Regression (satellite fix): the pre-pclint `_is_scalar_pull`
+    only inspected node.args[0]; keyword arguments slipped through."""
+    findings = active(lint_file(HostSyncChecker(),
+                                fx("hot_sync_legacy.py")))
+    assert any("float(x=" in f.source for f in findings)
+
+
+def test_collect_syncs_legacy_shape():
+    hits = collect_syncs(fx("hot_sync_legacy.py"))
+    assert hits == sorted(set(hits))
+    assert len(hits) == 2
+    assert all(isinstance(ln, int) and isinstance(s, str)
+               for ln, s in hits)
+
+
+def test_hot_registry_matches_batch():
+    """Every registered hot function must exist as a top-level def in
+    its registered file -- a renamed function must not silently fall
+    out of enforcement."""
+    for relpath, functions in HOT_PATH_FILES.items():
+        path = os.path.join(REPO, relpath)
+        tree = ast.parse(open(path, encoding="utf-8").read())
+        defined = {n.name for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        missing = set(functions) - defined
+        assert not missing, (
+            f"{relpath}: hot-path registry names {sorted(missing)} "
+            f"have no top-level def; update lint/hotpath.py")
+    assert MAX_CLEAN_SYNCS >= 2   # the implementation's floor
+
+
+# ---------------------------------------------------------------- PCL002
+
+def test_fault_site_fixture(tmp_path):
+    findings = lint_file(_fault_checker(tmp_path),
+                         fx("fault_sites_legacy.py"))
+    act = active(findings)
+    labels = sorted(m.split("`")[1] for m in (f.message for f in act))
+    assert labels == ["fixture:rescue[<i>]", "fixture:undocumented"]
+    assert len(inline(findings)) == 1
+    assert all("fixture:documented" not in f.message for f in findings)
+
+
+# ---------------------------------------------------------------- PCL003
+
+def test_purity_fixture_flags_print_under_jit():
+    findings = lint_file(JitPurityChecker(), fx("batch_legacy.py"))
+    act = active(findings)
+    assert len(act) == 1 and "print()" in act[0].message
+    assert "`batched`" in act[0].message   # the jit-by-name closure
+    sup = inline(findings)
+    assert len(sup) == 1 and "shape log" in sup[0].reason
+
+
+# ---------------------------------------------------------------- PCL004
+
+def test_tracer_fixture_flags_if_and_np_on_traced():
+    findings = lint_file(TracerLeakChecker(), fx("batch_legacy.py"))
+    act = active(findings)
+    msgs = sorted(f.message for f in act)
+    assert len(act) == 2, msgs
+    assert any("Python `if` on a jnp expression" in m for m in msgs)
+    assert any("np.asarray() on a traced value" in m for m in msgs)
+    assert len(inline(findings)) == 1
+
+
+def test_jit_closure_factory_is_detected():
+    """Acceptance proof: `batched` in the fixture is jitted only via
+    the `return jax.jit(batched)` factory idiom copied from
+    parallel/batch.py -- both JAX-aware rules must see through it."""
+    purity = active(lint_file(JitPurityChecker(), fx("batch_legacy.py")))
+    tracer = active(lint_file(TracerLeakChecker(), fx("batch_legacy.py")))
+    assert any("`batched`" in f.message for f in purity)
+    assert any("`batched`" in f.message for f in tracer)
+
+
+# ---------------------------------------------------------------- PCL005
+
+def test_dtype_fixture():
+    findings = lint_file(DtypeChecker(), fx("dtype_legacy.py"))
+    act = active(findings)
+    assert len(act) == 2
+    assert any("np.float64" in f.message for f in act)
+    assert any("\"float64\" dtype literal" in f.message for f in act)
+    sup = inline(findings)
+    assert len(sup) == 1 and "golden buffer" in sup[0].reason
+
+
+# ---------------------------------------------------------------- PCL006
+
+def test_env_fixture():
+    findings = lint_file(EnvRegistryChecker(), fx("env_legacy.py"))
+    act = active(findings)
+    assert len(act) == 1
+    assert ("PYCATKIN_" + "FIXTURE_ONLY_KNOB") in act[0].message
+    # the registered key and the inline-disabled key stay out
+    assert all(("PYCATKIN_" + "FAULTS") not in f.message
+               for f in findings)
+    assert len(inline(findings)) == 1
+
+
+def test_env_registry_documents_production_knobs():
+    from pycatkin_tpu.lint.env_registry import registered_keys
+    keys = registered_keys(os.path.join(REPO, "docs", "index.md"))
+    for k in ("FAULTS", "VALIDATE", "TPU_X64", "AOT_CACHE"):
+        assert ("PYCATKIN_" + k) in keys
+
+
+# ------------------------------------------------- suppression machinery
+
+_FIXTURE_MATRIX = [
+    ("PCL001", lambda tmp: HostSyncChecker(), "hot_sync_legacy.py"),
+    ("PCL002", _fault_checker, "fault_sites_legacy.py"),
+    ("PCL003", lambda tmp: JitPurityChecker(), "batch_legacy.py"),
+    ("PCL004", lambda tmp: TracerLeakChecker(), "batch_legacy.py"),
+    ("PCL005", lambda tmp: DtypeChecker(), "dtype_legacy.py"),
+    ("PCL006", lambda tmp: EnvRegistryChecker(), "env_legacy.py"),
+]
+
+
+@pytest.mark.parametrize("rule,make_checker,fixture",
+                         _FIXTURE_MATRIX,
+                         ids=[m[0] for m in _FIXTURE_MATRIX])
+def test_every_rule_detect_inline_baseline(rule, make_checker, fixture,
+                                           tmp_path):
+    """The ISSUE contract per rule: the fixture detects, inline
+    suppresses, and a baseline written from the active findings
+    silences a re-run completely (with zero stale entries)."""
+    path = fx(fixture)
+    findings = lint_file(make_checker(tmp_path), path)
+    assert active(findings), f"{rule}: fixture detected nothing"
+    assert inline(findings), f"{rule}: fixture proves no inline suppress"
+    assert all(f.rule == rule for f in findings)
+
+    bl = tmp_path / "lint_baseline.json"
+    baseline.save(str(bl), active(findings))
+    rerun = lint_file(make_checker(tmp_path), path)
+    rerun, stale = baseline.apply_to(rerun, str(bl))
+    assert not active(rerun), f"{rule}: baseline did not suppress"
+    assert not stale
+    assert all(f.suppressed == "baseline" for f in rerun
+               if f.suppressed != "inline")
+
+
+def test_baseline_fingerprint_survives_line_drift():
+    a = Finding(rule="PCL005", path="x.py", lineno=10, col=0,
+                message="m", source="bad = np.float64")
+    b = Finding(rule="PCL005", path="x.py", lineno=99, col=4,
+                message="m", source="bad  =  np.float64")
+    fa, = baseline.fingerprints([a])
+    fb, = baseline.fingerprints([b])
+    assert fa == fb            # content-addressed, whitespace-normalized
+    c = Finding(rule="PCL005", path="x.py", lineno=10, col=0,
+                message="m", source="bad = np.float32")
+    fc, = baseline.fingerprints([c])
+    assert fc != fa            # editing the line invalidates the entry
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    f = Finding(rule="PCL005", path="gone.py", lineno=1, col=0,
+                message="m", source="bad = np.float64")
+    bl = tmp_path / "lint_baseline.json"
+    baseline.save(str(bl), [f])
+    _, stale = baseline.apply_to([], str(bl))
+    assert len(stale) == 1 and stale[0]["path"] == "gone.py"
+
+
+def test_disable_all_silences_every_rule(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("import numpy as np\n"
+                 "x = np.float64(1.0)  # pclint: disable=all -- why\n",
+                 encoding="utf-8")
+    findings = lint_file(DtypeChecker(), str(p))
+    assert findings and all(f.suppressed == "inline" for f in findings)
+
+
+def test_syntax_error_becomes_pcl000(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def oops(:\n", encoding="utf-8")
+    doc = os.path.join(REPO, "docs", "index.md")
+    result = run_lint(root=str(tmp_path),
+                      checkers=[EnvRegistryChecker(doc_path=doc)],
+                      paths=["broken.py"])
+    assert [f.rule for f in result.findings] == ["PCL000"]
+
+
+def test_unknown_rule_selector_raises():
+    with pytest.raises(KeyError, match="PCL999"):
+        checkers_for(["PCL999"])
+    assert [c.rule for c in checkers_for(["tracer-leak", "PCL001"])] \
+        == ["PCL004", "PCL001"]
+
+
+# ------------------------------------------------------- the repo gate
+
+def _run_pclint(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pclint.py"),
+         *argv],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_repo_tree_is_lint_clean():
+    """The hard acceptance gate: the full default run (all rules, the
+    committed baseline) exits 0 on the current tree."""
+    proc = _run_pclint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pclint: OK" in proc.stdout
+
+
+@pytest.mark.skipif(shutil.which("make") is None,
+                    reason="make not installed")
+def test_make_lint_exits_zero():
+    proc = subprocess.run(["make", "lint"], cwd=REPO,
+                          env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_json_and_sarif_outputs_parse():
+    js = json.loads(_run_pclint("--format", "json").stdout)
+    assert js["counts"]["active"] == 0
+    assert {"PCL001", "PCL006"} <= set(js["rules"])
+    sarif = json.loads(_run_pclint("--format", "sarif").stdout)
+    assert sarif["version"] == "2.1.0"
+    rules = sarif["runs"][0]["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} >= {"PCL003", "PCL004", "PCL005"}

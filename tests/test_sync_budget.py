@@ -14,12 +14,14 @@ import numpy as np
 import pytest
 
 from pycatkin_tpu import engine
+# The budget AND the hot-path function list live in ONE registry module
+# shared with the PCL001 static checker (make lint) -- a function added
+# to the hot path is enforced by both mechanisms or neither.
+from pycatkin_tpu.lint.hotpath import MAX_CLEAN_SYNCS
 from pycatkin_tpu.models.synthetic import synthetic_system
 from pycatkin_tpu.parallel.batch import (broadcast_conditions,
                                          sweep_steady_state)
 from pycatkin_tpu.utils import profiling
-
-MAX_CLEAN_SYNCS = 3
 
 
 @pytest.fixture(scope="module")

@@ -1,117 +1,51 @@
 #!/usr/bin/env python
-"""Static check: every fault-site label is documented.
+"""Legacy shim: the fault-site lint now lives in the pclint framework.
 
-The failure subsystem (docs/failure_model.md) addresses faults by
-dispatch-site label -- the strings passed as ``label=`` to
-``call_with_backend_retry`` / ``run_chunk_with_ladder`` /
-``record_event`` / ``record_quarantine``, the label argument of
-``timed_retry``, and ``site = ...`` assignments. A label that exists in
-code but not in the doc is an undocumented failure branch: a fault plan
-targeting it works, but nobody reading the failure model knows it
-exists.
-
-This tool walks ``pycatkin_tpu/`` with the ``ast`` module (a regex
-would miss multi-line calls), normalizes f-string labels by replacing
-each interpolated field with ``<i>`` (consecutive fields collapse to
-one, so ``f"rescue[{a}{b}]"`` and ``f"rescue[{s}]"`` both become
-``rescue[<i>]``), and requires each normalized label to appear
-backticked in ``docs/failure_model.md``. Exit 0 when all labels are
-documented, 1 otherwise (listing label, file and line for each miss).
-
-Run directly or via ``make lint-faults``.
+The check itself is rule ``PCL002``
+(:mod:`pycatkin_tpu.lint.fault_sites`) run by ``tools/pclint.py`` /
+``make lint``: every fault-site label in ``pycatkin_tpu/`` must appear
+backticked in ``docs/failure_model.md``. This shim keeps the
+historical entry point (``make lint-faults`` calls pclint directly;
+running this file still works) and the historical module API
+(``PACKAGE``/``DOC``/``collect_sites``/``normalize``/
+``documented_labels``) that the shim's tests repoint.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.lint import fault_sites as _impl        # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(ROOT, "pycatkin_tpu")
 DOC = os.path.join(ROOT, "docs", "failure_model.md")
 
-# Only these callees take fault-site labels; collecting every `label=`
-# kwarg would false-positive on matplotlib legend labels.
-LABEL_FUNCS = {"call_with_backend_retry", "run_chunk_with_ladder",
-               "record_event", "record_quarantine", "timed_retry"}
-SITE_NAMES = {"site", "_site"}
+LABEL_FUNCS = set(_impl.LABEL_FUNCS)
+SITE_NAMES = set(_impl.SITE_NAMES)
+
+normalize = _impl.normalize
 
 
-def normalize(node) -> str | None:
-    """Literal or f-string label -> normalized site string (or None for
-    dynamic expressions, which cannot be statically checked)."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant):
-                parts.append(str(v.value))
-            else:
-                parts.append("<i>")
-        return re.sub(r"(<i>)+", "<i>", "".join(parts))
-    return None
-
-
-class SiteCollector(ast.NodeVisitor):
-    """Collect (normalized_label, lineno) pairs from one module."""
-
-    def __init__(self):
-        self.sites: list[tuple[str, int]] = []
-
-    def _add(self, node, value):
-        label = normalize(value)
-        if label is not None:
-            self.sites.append((label, node.lineno))
-
-    def visit_Call(self, node):
-        func = node.func
-        fname = getattr(func, "id", None) or getattr(func, "attr", "")
-        if fname in LABEL_FUNCS:
-            for kw in node.keywords:
-                if kw.arg == "label":
-                    self._add(node, kw.value)
-            if fname == "timed_retry" and len(node.args) >= 2:
-                self._add(node, node.args[1])
-        self.generic_visit(node)
-
-    def visit_Assign(self, node):
-        if any(isinstance(t, ast.Name) and t.id in SITE_NAMES
-               for t in node.targets):
-            self._add(node, node.value)
-        self.generic_visit(node)
-
-
-def collect_sites(package: str = PACKAGE):
+def collect_sites(package: str = None):
     """All statically-known fault-site labels in the package:
-    (label, relpath, lineno) triples, sorted."""
-    found = []
-    for dirpath, dirnames, filenames in os.walk(package):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as fh:
-                tree = ast.parse(fh.read(), filename=path)
-            collector = SiteCollector()
-            collector.visit(tree)
-            rel = os.path.relpath(path, ROOT)
-            found += [(label, rel, lineno)
-                      for label, lineno in collector.sites]
-    return sorted(found)
+    (label, relpath, lineno) triples, sorted. Delegates to the PCL002
+    checker's collector; globals looked up at call time so tests can
+    repoint PACKAGE."""
+    return _impl.collect_sites(PACKAGE if package is None else package,
+                               rel_to=ROOT)
 
 
-def documented_labels(doc_path: str = DOC) -> set:
+def documented_labels(doc_path: str = None) -> set:
     """Every backticked token in the failure-model doc."""
-    with open(doc_path) as fh:
-        return set(re.findall(r"`([^`\n]+)`", fh.read()))
+    return _impl.documented_labels(DOC if doc_path is None else doc_path)
 
 
 def main(argv=None) -> int:
-    # Globals looked up at call time so tests can repoint PACKAGE/DOC.
     sites = collect_sites(PACKAGE)
     documented = documented_labels(DOC)
     missing = [(label, rel, lineno) for label, rel, lineno in sites
@@ -125,7 +59,8 @@ def main(argv=None) -> int:
             print(f"  {rel}:{lineno}: `{label}`")
         return 1
     print(f"lint_fault_sites: OK -- {len(sites)} site reference(s), "
-          f"{len(labels)} distinct label(s), all documented")
+          f"{len(labels)} distinct label(s), all documented "
+          f"[delegated to pclint PCL002]")
     return 0
 
 

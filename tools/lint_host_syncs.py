@@ -1,106 +1,49 @@
 #!/usr/bin/env python
-"""Static check: no uncounted host syncs on the sweep hot path.
+"""Legacy shim: the host-sync lint now lives in the pclint framework.
 
-The sweep's latency budget is measured in blocking device->host
-materializations (on the tunneled axon backend each one costs ~0.8-1.2 s
-of round trip regardless of payload; docs/index.md "Performance").
-Every intentional materialization in the hot path must flow through
-``utils.profiling.host_sync`` -- the counted choke point that
-tests/test_sync_budget.py holds to a contractual budget -- or carry an
-explicit ``# sync-ok: <reason>`` annotation on its line marking it as a
-reviewed failure-path transfer.
+The check itself is rule ``PCL001`` (:mod:`pycatkin_tpu.lint.host_sync`)
+run by ``tools/pclint.py`` / ``make lint``; the hot-path function list
+moved to the shared registry :mod:`pycatkin_tpu.lint.hotpath` (one
+list, consumed by the checker AND tests/test_sync_budget.py). This
+shim keeps the historical entry point (``make lint-syncs`` calls
+pclint directly; running this file still works) and the historical
+module API (``TARGET``/``HOT_FUNCTIONS``/``collect_syncs``) that the
+shim's tests repoint.
 
-This tool parses ``pycatkin_tpu/parallel/batch.py`` with the ``ast``
-module and flags, inside the HOT_FUNCTIONS only, the two raw
-materialization idioms that history shows creep in during refactors:
-
-- ``np.asarray(...)``  (blocking copy of a device array)
-- ``int(jnp....)`` / ``float(jnp....)``  (scalar pull of a device value)
-
-Calls inside nested helper functions of a hot function count too (the
-closure runs on the hot path). Exit 0 when every such call is either
-routed through ``host_sync`` or annotated; 1 otherwise, listing file,
-line and source line for each miss.
-
-Run directly or via ``make lint-syncs``.
+Vs. the pre-pclint script, the migrated checker also fixes two
+misses: a ``# sync-ok:`` annotation now matches on ANY line of a
+multi-line call, and scalar pulls hiding in keyword arguments are
+caught (the old ``_is_scalar_pull`` only inspected ``args[0]``).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.lint import host_sync as _impl          # noqa: E402
+from pycatkin_tpu.lint import hotpath as _hotpath         # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TARGET = os.path.join(ROOT, "pycatkin_tpu", "parallel", "batch.py")
 
-# The sweep hot path: functions a clean (zero-failure) sweep executes,
-# plus the failure-path functions whose syncs must stay labeled.
-HOT_FUNCTIONS = {"batch_steady_state", "sweep_steady_state",
-                 "_finish_sweep", "_rescue", "_quarantine_mask",
-                 "stability_mask", "continuation_sweep"}
-
-ANNOTATION = "# sync-ok:"
+HOT_FUNCTIONS = set(_hotpath.HOT_FUNCTIONS)
+ANNOTATION = _hotpath.SYNC_ANNOTATION
 
 
-def _is_np_asarray(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr == "asarray"
-            and isinstance(f.value, ast.Name) and f.value.id == "np")
-
-
-def _is_scalar_pull(node: ast.Call) -> bool:
-    """int(...)/float(...) whose argument expression mentions jnp --
-    a device scalar pulled to the host."""
-    f = node.func
-    if not (isinstance(f, ast.Name) and f.id in ("int", "float")):
-        return False
-    if not node.args:
-        return False
-    arg = node.args[0]
-    # int(host_sync(...)) IS the counted idiom, not a bypass.
-    if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
-            and arg.func.id == "host_sync"):
-        return False
-    for sub in ast.walk(node.args[0]):
-        if isinstance(sub, ast.Name) and sub.id == "jnp":
-            return True
-        if isinstance(sub, ast.Call):
-            sf = sub.func
-            if (isinstance(sf, ast.Attribute)
-                    and isinstance(sf.value, ast.Name)
-                    and sf.value.id == "jnp"):
-                return True
-    return False
-
-
-def collect_syncs(path: str = TARGET):
+def collect_syncs(path: str = None):
     """(lineno, source_line) of every raw materialization inside a hot
-    function that lacks a ``# sync-ok:`` annotation."""
-    with open(path) as fh:
-        source = fh.read()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=path)
-    flagged = []
-    for top in tree.body:
-        if not isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if top.name not in HOT_FUNCTIONS:
-            continue
-        for node in ast.walk(top):
-            if not isinstance(node, ast.Call):
-                continue
-            if not (_is_np_asarray(node) or _is_scalar_pull(node)):
-                continue
-            src = lines[node.lineno - 1]
-            if ANNOTATION in src:
-                continue
-            flagged.append((node.lineno, src.strip()))
-    return sorted(set(flagged))
+    function that lacks a ``# sync-ok:`` annotation. Delegates to the
+    PCL001 checker; module globals are looked up at call time so tests
+    can repoint TARGET/HOT_FUNCTIONS."""
+    return _impl.collect_syncs(TARGET if path is None else path,
+                               hot_functions=HOT_FUNCTIONS)
 
 
 def main(argv=None) -> int:
-    # Globals looked up at call time so tests can repoint TARGET.
     flagged = collect_syncs(TARGET)
     rel = os.path.relpath(TARGET, ROOT)
     if flagged:
@@ -112,7 +55,8 @@ def main(argv=None) -> int:
             print(f"  {rel}:{lineno}: {src}")
         return 1
     print(f"lint_host_syncs: OK -- no uncounted materializations in "
-          f"{rel} hot path ({', '.join(sorted(HOT_FUNCTIONS))})")
+          f"{rel} hot path ({', '.join(sorted(HOT_FUNCTIONS))}) "
+          f"[delegated to pclint PCL001]")
     return 0
 
 

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""pclint runner: the repo's unified static-analysis gate.
+
+Thin launcher for :mod:`pycatkin_tpu.lint` (the checker framework);
+``make lint`` runs this with no arguments and must exit 0 on a clean
+tree. Rules, suppression syntax (inline ``# pclint: disable=<rule> --
+<reason>`` and the committed ``lint_baseline.json``), and the baseline
+workflow are documented in docs/static_analysis.md.
+
+Examples::
+
+    python tools/pclint.py                      # everything
+    python tools/pclint.py --rules PCL001       # host-sync only
+    python tools/pclint.py --format sarif       # CI annotations
+    python tools/pclint.py --update-baseline    # re-grandfather
+    python tools/pclint.py --list-rules
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pycatkin_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
