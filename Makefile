@@ -14,11 +14,22 @@
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test test-faults test-validate test-all lint lint-faults \
-	lint-syncs lint-baseline bench-smoke
+.PHONY: test test-faults test-validate test-sharded test-all lint \
+	lint-faults lint-syncs lint-baseline bench-smoke
 
 test:
 	$(PYTEST) -m 'not slow'
+
+# Sharded-equality lane: the mesh-vs-no-mesh bit-identity and
+# consolidated-rescue equivalence tests on exactly 2 virtual host
+# devices (the configuration the equality contract is pinned to --
+# see tests/test_sharded_sweep.py's module docstring).
+test-sharded:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+		python -m pytest tests/test_sharded_sweep.py \
+		tests/test_consolidated_rescue.py -q \
+		-p no:cacheprovider
 
 test-faults:
 	$(PYTEST) -m faults

@@ -28,6 +28,18 @@ import numpy as np
 
 GRID_N = int(os.environ.get("BENCH_GRID_N", "256"))
 BASELINE_SAMPLE = int(os.environ.get("BENCH_BASELINE_SAMPLE", "6"))
+
+# The production prewarm layout (shared with --smoke, which holds its
+# program count to parallel.batch.PREWARM_PROGRAM_BUDGET without paying
+# for the compiles). 512 rides in the EXECUTED buckets: the timed
+# trials' failed subset lands there, and an AOT-only program still pays
+# a ~4-7 s first-execution load. The sub-512 tier-2 jac shapes are
+# gone: the hot path floors the ambiguous subset at TIER2_MIN_BUCKET,
+# so 512 is the smallest reachable jac shape.
+FULL_PREWARM_LAYOUT = dict(buckets=(64, 128, 256, 512),
+                           aot_buckets=(1024,),
+                           tier2_buckets=(8192, 16384),
+                           tier2_aot_buckets=(512, 1024, 2048, 4096))
 REFERENCE_INPUT = os.environ.get(
     "PYCATKIN_REFERENCE_INPUT",
     "/root/reference/examples/COOxVolcano/input.json")
@@ -147,32 +159,36 @@ def main():
     import jax.numpy as jnp
     conds = jax.tree_util.tree_map(jnp.asarray, conds)
 
-    # Pre-warm EVERY program shape the sweep can touch (fast pass,
-    # PTC/LM rescue seeded+unseeded at the pow2 buckets, stability
-    # screen + tier-2 Jacobian, TOF/activity): the rescue/tier-2
-    # programs otherwise compile lazily the first time lanes fail --
-    # tens of seconds of remote compile, plus its transport-flake risk,
-    # INSIDE a timed trial (the round-4 bench died exactly there). On a
-    # warm persistent cache this is a disk load; cold it is the full
-    # compile bill, paid here and nowhere else.
-    from pycatkin_tpu.parallel.batch import (clear_program_caches,
+    # Pre-warm EVERY program shape the sweep can touch (fast pass, the
+    # consolidated per-bucket rescue program, stability screen + tier-2
+    # Jacobian, TOF/activity): the rescue/tier-2 programs otherwise
+    # compile lazily the first time lanes fail -- tens of seconds of
+    # remote compile, plus its transport-flake risk, INSIDE a timed
+    # trial (the round-4 bench died exactly there). On a warm
+    # persistent cache this is a disk load; cold it is the full compile
+    # bill, paid here and nowhere else.
+    from pycatkin_tpu.parallel.batch import (PREWARM_PROGRAM_BUDGET,
+                                             clear_program_caches,
+                                             make_mesh,
                                              prewarm_sweep_programs)
     from pycatkin_tpu.utils.retry import call_with_backend_retry
 
-    # 512 rides in the EXECUTED buckets: the timed trials' failed
-    # subset lands there (measured 269 fail at trial T-shifts vs 246
-    # at the warmup shift -> bucket 256), and an AOT-only program
-    # still pays a ~4-7 s first-execution load -- which showed up as a
-    # systematically slow FIRST timed trial in every round-5 run until
-    # this was executed during prewarm instead.
+    # Full-mesh sweep: the whole pipeline (solve, rescue ladder,
+    # stability tiers, TOF) is mesh-aware and the program keys carry
+    # the sharding fingerprint, so the prewarmed executables below are
+    # exactly what the sharded sweeps dispatch. On one device the mesh
+    # degenerates to the unsharded key space (trivial-mesh tags are
+    # empty).
+    mesh = make_mesh()
+    log(f"mesh: {mesh.devices.size} device(s) over axis "
+        f"'{mesh.axis_names[0]}'")
+
     def run_prewarm(verbose):
         return prewarm_sweep_programs(spec, conds, tof_mask=mask,
-                                      buckets=(64, 128, 256, 512),
-                                      aot_buckets=(1024,),
-                                      tier2_buckets=(8192, 16384),
-                                      tier2_aot_buckets=(2048, 4096),
                                       check_stability=True,
-                                      verbose=verbose)
+                                      verbose=verbose,
+                                      mesh=mesh,
+                                      **FULL_PREWARM_LAYOUT)
 
     t0 = time.perf_counter()
     n_prog = run_prewarm(verbose=True)
@@ -206,7 +222,8 @@ def main():
     t0 = time.perf_counter()
     out = call_with_backend_retry(
         sweep_steady_state, spec, conds._replace(T=conds.T + 0.25),
-        tof_mask=mask, check_stability=True, label="warmup sweep")
+        tof_mask=mask, check_stability=True, mesh=mesh,
+        label="warmup sweep")
     np.asarray(out["y"])
     compile_and_run = time.perf_counter() - t0
     log(f"warmup sweep: {compile_and_run:.2f} s")
@@ -245,13 +262,24 @@ def main():
                              + 1.0e-8 * attempt)
         t0 = time.perf_counter()
         o = sweep_steady_state(spec, c_i, tof_mask=mask,
-                               check_stability=True)
+                               check_stability=True, mesh=mesh)
         float(np.asarray(checksum(o["y"], o["activity"], o["success"])))
         return time.perf_counter() - t0, o
 
     from pycatkin_tpu.utils import profiling
 
+    def _span_totals(events):
+        """Per-label wall totals {label: seconds} for a slice of span
+        events (one trial's variance-forensics fingerprint)."""
+        tot: dict = {}
+        for ev in events:
+            lbl = str(ev.get("label"))
+            tot[lbl] = round(tot.get(lbl, 0.0)
+                             + float(ev.get("dur", 0.0)), 4)
+        return tot
+
     walls, last, trial_rescues = [], None, []
+    trial_spans, trial_syncs = [], []
     for i in range(3):
         # Trial-level retry: a transient backend flake re-runs the
         # whole (pure) trial rather than killing the round's record.
@@ -268,10 +296,15 @@ def main():
             return timed_trial(i, attempt["n"])
 
         n_rescue_before = len(profiling.peek_events("rescue"))
+        n_span_before = len(profiling.peek_events("span"))
+        sync_before = profiling.sync_count()
         w, out = call_with_backend_retry(trial_once,
                                          label=f"timed trial {i}")
         walls.append(w)
         last = out
+        trial_spans.append(_span_totals(
+            profiling.peek_events("span")[n_span_before:]))
+        trial_syncs.append(profiling.sync_count() - sync_before)
         # Per-trial rescue funnel (straggler forensics for the trial
         # wall variance): each rescue pass records how many lanes it
         # received and how many stayed failed.
@@ -290,6 +323,26 @@ def main():
     log(f"batched solve walls: {['%.3f s' % w for w in walls]} "
         f"(median {wall:.3f} s, {pts_per_s:.0f} pts/s), "
         f"{n_ok}/{n_points} converged+stable ({n_stable} stable)")
+
+    # Slow-trial attribution: when one trial's wall exceeds the median
+    # by >30%, name the span whose duration grew the most between the
+    # median and slowest trials instead of leaving the outlier as an
+    # anonymous number.
+    max_over_median = round(max(walls) / wall, 3)
+    outlier_span = None
+    if max_over_median > 1.3:
+        slow_i = walls.index(max(walls))
+        med_i = walls.index(wall)
+        labels = set(trial_spans[slow_i]) | set(trial_spans[med_i])
+        deltas = {lbl: trial_spans[slow_i].get(lbl, 0.0)
+                  - trial_spans[med_i].get(lbl, 0.0) for lbl in labels}
+        if deltas:
+            dom = max(deltas, key=lambda k: deltas[k])
+            outlier_span = {"label": dom,
+                            "extra_s": round(deltas[dom], 3)}
+            log(f"slow-trial outlier: trial {slow_i} "
+                f"({max(walls):.3f} s vs median {wall:.3f} s); "
+                f"dominant span: {dom} (+{deltas[dom]:.3f} s)")
 
     vs_baseline = None
     if have_ref:
@@ -328,8 +381,23 @@ def main():
         "prewarm_warm_s": round(prewarm_warm_s, 2),
         "prewarm_compiled": int(n_prog.compiled),
         "prewarm_loaded": int(n_prog.loaded),
+        # Program-zoo diet accounting: total distinct programs the
+        # prewarm ensured, held to PREWARM_PROGRAM_BUDGET by the smoke
+        # lane (full-bench layout must stay within the same budget).
+        "n_programs_prewarmed": int(n_prog),
+        "program_budget": int(PREWARM_PROGRAM_BUDGET),
+        "mesh_devices": int(mesh.devices.size),
         # Per-trial rescue funnel: [[{pass, n_failed, n_remaining}]].
         "trial_rescues": trial_rescues,
+        # Variance forensics: raw per-trial walls, counted host syncs
+        # per trial, and per-trial span totals ({label: seconds}) from
+        # utils.profiling -- plus the named dominant span whenever the
+        # slowest trial exceeds the median by >30%.
+        "trial_walls": [round(w, 3) for w in walls],
+        "sync_count": trial_syncs,
+        "trial_spans": trial_spans,
+        "max_over_median": max_over_median,
+        "outlier_span": outlier_span,
     }
 
     # Regression tripwire vs the checked-in prior round (VERDICT r3
@@ -387,13 +455,23 @@ def smoke_main():
 
     import tempfile
 
-    from pycatkin_tpu.parallel.batch import (prewarm_sweep_programs,
+    from pycatkin_tpu.parallel.batch import (PREWARM_PROGRAM_BUDGET,
+                                             prewarm_program_count,
+                                             prewarm_sweep_programs,
                                              sweep_steady_state)
     from pycatkin_tpu.utils import profiling
 
     sim, spec, conds, mask, metric, _ = _build_problem()
     n = GRID_N * GRID_N
     max_syncs = 5
+
+    # Program-zoo diet gate: the production bench layout, counted
+    # arithmetically (one consolidated rescue program per bucket, jac
+    # at tier-2 shapes only), must fit PREWARM_PROGRAM_BUDGET. Catches
+    # any layout growth or a prewarm regression back toward the r05
+    # four-variants-per-bucket zoo before it costs bench wall time.
+    planned = prewarm_program_count(tof=True, check_stability=True,
+                                    **FULL_PREWARM_LAYOUT)
 
     # Scratch AOT cache: the smoke lane must not depend on (or pollute)
     # the repo's real cache directory.
@@ -415,14 +493,21 @@ def smoke_main():
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
     # rescue ladder its (labeled, counted) failure-path syncs.
     breach = clean and budget.count > max_syncs
+    budget_breach = (int(n_prog) > PREWARM_PROGRAM_BUDGET
+                     or planned > PREWARM_PROGRAM_BUDGET)
     result = {
         "metric": metric + " (smoke)",
         "n_points": n,
         "converged": n_ok,
         "prewarm_s": round(prewarm_s, 2),
         "prewarm_programs": int(n_prog),
+        "n_programs_prewarmed": int(n_prog),
+        "full_bench_programs": planned,
+        "program_budget": int(PREWARM_PROGRAM_BUDGET),
+        "program_budget_ok": not budget_breach,
         "wall_s": round(wall, 2),
         "host_syncs": budget.count,
+        "sync_count": budget.count,
         "sync_labels": budget.labels,
         "max_syncs": max_syncs,
         "sync_budget_ok": not breach,
@@ -430,12 +515,18 @@ def smoke_main():
         "lint_findings": 0,
     }
     print(json.dumps(result))
+    if budget_breach:
+        log(f"bench-smoke: FAIL -- program count over budget "
+            f"(smoke prewarmed {int(n_prog)}, full bench layout "
+            f"{planned}, budget {PREWARM_PROGRAM_BUDGET})")
+        return 1
     if breach:
         log(f"bench-smoke: FAIL -- clean sweep spent {budget.count} "
             f"host syncs (budget {max_syncs}): {budget.labels}")
         return 1
     log(f"bench-smoke: OK -- {budget.count} host sync(s) on the sweep, "
-        f"{n_ok}/{n} converged")
+        f"{n_ok}/{n} converged, {int(n_prog)} program(s) prewarmed "
+        f"(full bench layout {planned}/{PREWARM_PROGRAM_BUDGET})")
     return 0
 
 

@@ -255,16 +255,24 @@ def _dynamic_jacobian(spec: ModelSpec, cond: Conditions, kf, kr):
 def steady_state(spec: ModelSpec, cond: Conditions,
                  x0=None, key=None,
                  opts: SolverOptions = SolverOptions(),
-                 strategy: str = "ptc") -> SteadyStateResults:
+                 strategy: str = "ptc",
+                 use_x0=None) -> SteadyStateResults:
     """Steady-state solve over the dynamic indices (adsorbates, plus gas
     for CSTR), gas clamped otherwise -- reference system.py:512-639 /
     old_system.py:385-434 semantics with on-device retry logic.
-    ``strategy``: 'ptc' or 'lm' (see newton.solve_steady)."""
+    ``strategy``: 'ptc' or 'lm' (see newton.solve_steady).
+    ``use_x0``: optional traced boolean selecting between the supplied
+    ``x0`` (True) and the default initial coverages (False) -- lets the
+    consolidated rescue program keep seeded/unseeded variants inside
+    ONE compiled program instead of two (x0=None is a different
+    treedef, hence a different program)."""
     kf, kr, _ = rate_constants(spec, cond)
     fscale, dyn, y_base = _dynamic_fscale(spec, cond, kf, kr)
     jac = jax.jacfwd(lambda x: fscale(x)[0])
     if x0 is None:
         x0 = y_base[dyn]
+    elif use_x0 is not None:
+        x0 = jnp.where(use_x0, jnp.asarray(x0), y_base[dyn])
     groups_dyn = jnp.asarray(spec.groups)[:, dyn]
     (x, success, res, iters, attempts, rate_ok, pos_ok, sums_ok,
      dt_exit) = newton.solve_steady(

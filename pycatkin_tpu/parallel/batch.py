@@ -16,6 +16,7 @@ simply stop improving, which is the price of SIMD execution.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Callable, Optional
 
@@ -28,9 +29,24 @@ from .. import engine
 from ..frontend.spec import Conditions, ModelSpec
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
-from ..utils.profiling import host_sync, record_event
+from ..utils.profiling import host_sync, record_event, span
 from ..utils.retry import call_with_backend_retry
 from . import compile_pool
+
+# Program-zoo budget: the number of distinct programs a full production
+# prewarm (bench.py bucket layout) may touch. The r05 zoo held 32
+# (4 strategy-specific rescue programs per solve bucket); consolidating
+# them into ONE strategy-parameterized rescue program per bucket shape
+# brings the full layout to 14. bench.py --smoke asserts the ceiling.
+PREWARM_PROGRAM_BUDGET = 14
+
+# Floor (pow2) for the stability tier-2 Jacobian subset shape: ambiguous
+# counts drift trial to trial, and every distinct pow2 shape below the
+# floor used to be its own compiled program (4 warmed shapes at 64..512
+# in r05). One 512 floor collapses them to a single program; the pad
+# lanes are sliced off ON DEVICE before the host transfer, so only the
+# device flops (cheap) grow, never the tunnel payload.
+TIER2_MIN_BUCKET = 512
 
 
 # ---------------------------------------------------------------------
@@ -49,6 +65,7 @@ def clear_program_caches():
     including the engine-level transient chunk/finish programs and the
     AOT executable registry (compile_pool)."""
     _steady_program.cache_clear()
+    _rescue_program.cache_clear()
     _transient_chunk_program.cache_clear()
     _transient_finish_program.cache_clear()
     _tof_program.cache_clear()
@@ -67,11 +84,44 @@ def clear_program_caches():
 # populate jit's dispatch cache, so without the registry an AOT-loaded
 # executable would never actually run and the first in-band hit would
 # silently re-trace + re-compile.
-def _steady_kind(opts: SolverOptions, strategy: str) -> str:
+def _sharding_tag(sharding) -> str:
+    """Kind-string suffix for a program compiled with explicit
+    ``out_shardings``. Trivial (None / one-device) shardings map to the
+    empty string, so a mesh of 1 produces byte-identical kinds -- and
+    therefore registry hits -- against the unsharded prewarm."""
+    if sharding is None:
+        return ""
+    try:
+        sizes = tuple(sharding.mesh.shape.items())
+    except Exception:
+        return ""
+    if all(s <= 1 for _, s in sizes):
+        return ""
+    axes = ";".join(f"{k}={v}" for k, v in sizes)
+    return f"@mesh[{axes}]{sharding.spec}"
+
+
+def _steady_kind(opts: SolverOptions, strategy: str,
+                 sharding=None) -> str:
     """Registry/cache kind string for a steady-solve program variant;
     prewarm and the hot path MUST derive it identically (shapes ride in
     the key separately)."""
-    return f"steady:{strategy}:{opts!r}"
+    return f"steady:{strategy}:{opts!r}{_sharding_tag(sharding)}"
+
+
+def _pacing_key(opts: SolverOptions) -> SolverOptions:
+    """Options with the four TRACED pacing knobs of the consolidated
+    rescue program replaced by sentinels: every ladder rung that
+    differs only in pacing (polish vs full PTC vs the unseeded demote
+    re-solve) normalizes to the same value, hence the same compiled
+    program. The verdict tolerances (and the STATIC chord_steps) stay
+    in the key -- they are compile-time constants of the program."""
+    return opts._replace(dt0=-1.0, dt_grow_min=-1.0, max_steps=-1,
+                         max_attempts=-1)
+
+
+def _rescue_kind(opts: SolverOptions, sharding=None) -> str:
+    return f"rescue:{_pacing_key(opts)!r}{_sharding_tag(sharding)}"
 
 
 def _screen_kind(pos_tol: float, backend: str) -> str:
@@ -93,7 +143,22 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
             compile_pool.unregister(spec, key)
             record_event("degradation", label="aot:fallback",
                          error=f"{type(e).__name__}: {e}"[:200])
-    return prog(*args)
+    # Registry miss: the jitted fallback traces + compiles SYNCHRONOUSLY
+    # on its first call at this shape, which is exactly the in-band
+    # recompile the variance forensics hunt for -- the span carries the
+    # wall so a slow trial can be attributed to a named program.
+    with span(f"inband:{kind.split(':', 1)[0]}", key=key[:8]):
+        return prog(*args)
+
+
+def _donate_argnums(argnums):
+    """Buffer donation for the solve programs, gated OFF on CPU where
+    XLA ignores donation with a warning per call (and the aliasing buys
+    nothing -- host RAM is not the scarce resource). Callers that
+    donate MUST rebuild the donated arguments inside their retried
+    closures: a retry after a transient flake would otherwise re-feed
+    already-consumed buffers."""
+    return () if jax.default_backend() == "cpu" else tuple(argnums)
 
 
 @lru_cache(maxsize=16)
@@ -103,9 +168,60 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
         return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
                                    strategy=strategy)
     fn = jax.vmap(solve_one)
+    # Only the PRNG keys are donated: x0 may be caller-owned (sweep
+    # seeds, continuation stage solutions) and conds are reused by
+    # every downstream tail program.
+    kw = {"donate_argnums": _donate_argnums((1,))}
     if out_sharding is not None:
-        return jax.jit(fn, out_shardings=out_sharding)
-    return jax.jit(fn)
+        kw["out_shardings"] = out_sharding
+    return jax.jit(fn, **kw)
+
+
+@lru_cache(maxsize=16)
+def _rescue_program(spec: ModelSpec, pacing: SolverOptions,
+                    out_sharding=None):
+    """ONE strategy-parameterized rescue program per (spec, verdict
+    tolerances, bucket shape): the r05 zoo compiled four separate
+    programs per bucket (polish / full PTC / LM / unseeded PTC). Here
+
+    - PTC vs LM is a static branch PAIR under a scalar ``lax.cond``
+      (the predicate is unbatched, so XLA keeps it a true conditional:
+      only the selected solver executes);
+    - seeded vs unseeded is a traced per-program select
+      (``engine.steady_state(use_x0=...)`` -- x0 is always a concrete
+      array, never a treedef-changing None);
+    - the pacing knobs (dt0, dt_grow_min, max_steps, max_attempts) ride
+      in as traced scalars, so the polish rung and the full ladder are
+      the same executable called with different numbers.
+
+    ``pacing`` must be pre-normalized via :func:`_pacing_key` (the
+    lru_cache would otherwise split per pacing value and resurrect the
+    zoo this program exists to collapse)."""
+    def make(strategy):
+        def solve_one(cond, key, x0, seeded, dt0, grow, max_steps,
+                      max_attempts):
+            o = pacing._replace(dt0=dt0, dt_grow_min=grow,
+                                max_steps=max_steps,
+                                max_attempts=max_attempts)
+            return engine.steady_state(spec, cond, x0=x0, key=key,
+                                       opts=o, strategy=strategy,
+                                       use_x0=seeded)
+        return jax.vmap(solve_one,
+                        in_axes=(0, 0, 0) + (None,) * 5)
+    run_ptc, run_lm = make("ptc"), make("lm")
+
+    def program(conds, keys, x0, strat, seeded, dt0, grow, max_steps,
+                max_attempts):
+        args = (conds, keys, x0, seeded, dt0, grow, max_steps,
+                max_attempts)
+        return jax.lax.cond(strat == 1,
+                            lambda a: run_lm(*a),
+                            lambda a: run_ptc(*a), args)
+
+    kw = {"donate_argnums": _donate_argnums((1, 2))}
+    if out_sharding is not None:
+        kw["out_shardings"] = out_sharding
+    return jax.jit(program, **kw)
 
 
 @lru_cache(maxsize=16)
@@ -200,9 +316,7 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     guesses. With a mesh, lanes are sharded across devices.
     Returns a lane-batched SteadyStateResults.
     """
-    keys = jax.random.split(
-        jax.random.PRNGKey(0),
-        jax.tree_util.tree_leaves(conds)[0].shape[0])
+    n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
 
     # Retry covers BOTH failure windows: the dispatch (this is the
     # LARGEST lazy compile of the sweep surface, so a dropped
@@ -210,39 +324,56 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     # execution, which on the async backend only surfaces at a
     # materialization -- hence the one-scalar sync inside the retried
     # unit (~0.1 s round trip; downstream consumers materialize a
-    # scalar off this result immediately anyway).
+    # scalar off this result immediately anyway). The PRNG keys are
+    # rebuilt inside the retried closures: the solve program donates
+    # its key buffer, so a retry must never re-feed a consumed array.
     if mesh is None:
         prog = _steady_program(spec, opts)
         kind = _steady_kind(opts, "ptc")
 
         def run_solve():
+            keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
             out = _registered_call(spec, kind, prog, (conds, keys, x0))
             host_sync(jnp.sum(out.residual), "solve fence")
             return out
 
-        return call_with_backend_retry(run_solve,
-                                       label="batched steady solve")
+        with span("solve dispatch"):
+            return call_with_backend_retry(run_solve,
+                                           label="batched steady solve")
 
     n_dev = mesh.devices.size
     conds_p, n = _pad_lanes(conds, n_dev)
-    keys_p, _ = _pad_lanes(keys, n_dev)
     x0_p = None
     if x0 is not None:
         x0_p, _ = _pad_lanes(x0, n_dev)
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
     conds_p = jax.device_put(conds_p, sharding)
+    if x0_p is not None:
+        x0_p = jax.device_put(x0_p, sharding)
     prog_sh = _steady_program(spec, opts, sharding)
+    # The mesh path consults the registry like every other dispatch:
+    # program keys carry the per-argument sharding fingerprint
+    # (compile_pool._shape_signature), so a serialized executable is
+    # only matched by calls with the very mesh layout it baked in --
+    # prewarm(mesh=...) publishes those, and single-device entries can
+    # never be confused for them.
+    kind_sh = _steady_kind(opts, "ptc", sharding)
 
     def run_solve_sharded():
-        # The registry is bypassed on the mesh path: serialized
-        # executables bake in shardings prewarm never sees.
-        out = prog_sh(conds_p, keys_p, x0_p)
+        keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
+        keys_p, _ = _pad_lanes(keys, n_dev)
+        keys_p = jax.device_put(keys_p, sharding)
+        out = _registered_call(spec, kind_sh, prog_sh,
+                               (conds_p, keys_p, x0_p))
         host_sync(jnp.sum(out.residual), "solve fence (sharded)")
         return out
 
-    out = call_with_backend_retry(run_solve_sharded,
-                                  label="batched steady solve (sharded)")
+    with span("solve dispatch"):
+        out = call_with_backend_retry(
+            run_solve_sharded, label="batched steady solve (sharded)")
+    if n == jax.tree_util.tree_leaves(conds_p)[0].shape[0]:
+        return out
     return jax.tree_util.tree_map(lambda x: x[:n], out)
 
 
@@ -390,10 +521,36 @@ def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
     return (sub, idx_p) + tuple(jnp.asarray(a)[idx_p] for a in arrays)
 
 
+def _subset_sharding(mesh: Optional[Mesh], n_sub: int):
+    """Lane sharding for a gathered subset when the mesh divides it
+    evenly, else None (single-device placement)."""
+    if mesh is None or n_sub % mesh.devices.size != 0:
+        return None
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def _place_subset(mesh: Optional[Mesh], n_sub: int, *trees):
+    """Deterministic device placement for gathered subset pytrees.
+    Gathering from a SHARDED parent leaves the output layout -- hence
+    the program-key sharding fingerprint -- to the compiler's whim;
+    pinning it makes the hot path hit the very executables prewarm
+    registered. Lane-shard across the mesh when the subset divides it,
+    else commit to one device (fingerprints as unsharded). With no
+    mesh the inputs pass through untouched -- the unsharded path stays
+    byte-identical to its pre-mesh behavior."""
+    if mesh is None:
+        return trees if len(trees) > 1 else trees[0]
+    sh = _subset_sharding(mesh, n_sub)
+    tgt = sh if sh is not None else jax.devices()[0]
+    placed = tuple(jax.device_put(t, tgt) for t in trees)
+    return placed if len(placed) > 1 else placed[0]
+
+
 def stability_mask(spec: ModelSpec, conds: Conditions, ys,
                    pos_tol: float = 1e-2, ok=None,
                    backend: Optional[str] = None,
-                   precomputed=None) -> jnp.ndarray:
+                   precomputed=None,
+                   mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """[lanes] Jacobian-eigenvalue stability verdict (reference
     solver.py:102-106) for batched steady solutions, two-tier:
 
@@ -421,15 +578,18 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     backend at call time). ``precomputed``: an already-dispatched
     ``(certified, ambiguous, n_ambiguous)`` triple from the SAME screen
     program on the SAME ``ys``/``ok`` (the fused sweep tail's
-    speculative screen) -- skips re-running tier 1. Returns a DEVICE
-    bool array.
+    speculative screen) -- skips re-running tier 1. ``mesh``: lane mesh
+    of a sharded sweep -- the tier-2 Jacobian subset is re-placed on it
+    (lane-sharded) so the prewarmed sharded jac program is hit instead
+    of compiling an unsharded twin in-band. Returns a DEVICE bool
+    array.
     """
     from ..solvers.newton import stability_tolerance
     ys = jnp.asarray(ys)
     n = ys.shape[0]
     ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
               else jnp.ones(n, dtype=bool))
-    backend = _resolve_backend(backend)
+    backend = _resolve_backend(backend, mesh)
     if precomputed is not None:
         certified, ambiguous, n_amb = precomputed
         n_amb = int(n_amb)
@@ -447,11 +607,17 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
             return cert, amb, int(host_sync(n_amb_dev,
                                             "stability screen"))
 
-        certified, ambiguous, n_amb = call_with_backend_retry(
-            run_screen, label="stability screen")
+        with span("stability screen"):
+            certified, ambiguous, n_amb = call_with_backend_retry(
+                run_screen, label="stability screen")
     if n_amb:
         idx = np.flatnonzero(np.asarray(ambiguous))  # sync-ok: tier-2 failure path
-        sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,))
+        # Ambiguous counts drift trial to trial; the TIER2_MIN_BUCKET
+        # floor collapses every sub-512 count onto ONE compiled shape
+        # (pads are sliced off on device before the host transfer).
+        sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,),
+                                          bucket=TIER2_MIN_BUCKET)
+        sub, ys_p = _place_subset(mesh, len(idx_p), sub, ys_p)
 
         # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
         # never cross the ~11 MB/s tunnel (pow2 padding can nearly
@@ -462,8 +628,9 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
                                  (sub, ys_p))[:len(idx)],
                 "tier-2 jacobian")
 
-        Js = call_with_backend_retry(run_jac,
-                                     label="stability tier-2 jacobian")
+        with span("tier-2 jacobian"):
+            Js = call_with_backend_retry(
+                run_jac, label="stability tier-2 jacobian")
         eig = np.linalg.eigvals(Js)
         tol_sub = stability_tolerance(Js, pos_tol)
         host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
@@ -540,7 +707,8 @@ def _chunked_nearest(Xf: np.ndarray, Xo: np.ndarray,
 def _rescue(spec: ModelSpec, conds: Conditions, res,
             opts: SolverOptions, strategy: str, pad_to: int = 64,
             seed: int = 1, use_x0: bool = True,
-            neighbor_seed: bool = False, n_failed: int | None = None):
+            neighbor_seed: bool = False, n_failed: int | None = None,
+            mesh: Optional[Mesh] = None):
     """Host-side second pass over FAILED lanes only: re-solve the failed
     subset with the given strategy/options from the best iterates of the
     first pass. Padded to a multiple of ``pad_to`` so recompiles stay
@@ -562,8 +730,18 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     ``n_failed``: the caller's already-materialized failed-lane count
     (skips this function's scalar pre-check round trip -- each
     materialization call costs ~0.1-1 s on the tunneled backend).
+    ``mesh``: the sweep's lane mesh -- the failed subset is re-placed
+    on it so the prewarmed SHARDED rescue executable is hit, and the
+    merged result is re-sharded so downstream tail programs keep their
+    sharded program keys.
     Returns ``(res, n_remaining)`` with the post-rescue failed count,
-    so chained rescue passes never re-materialize it."""
+    so chained rescue passes never re-materialize it.
+
+    Every rung of the ladder dispatches the ONE consolidated rescue
+    program (:func:`_rescue_program`): strategy / seededness / pacing
+    ride in as traced scalars, so polish, full PTC, LM and the unseeded
+    demote re-solve share a single compiled executable per bucket
+    shape."""
     # Scalar pre-check (only when the caller didn't already know): the
     # full mask crosses to the host only when lanes actually failed
     # (the common volcano case is zero failures -> one cheap scalar).
@@ -580,26 +758,47 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
         nn = _neighbor_seed_lanes(conds, success)
         if nn is not None:
             seed_lane = nn[idx_p]
-    x0 = (jnp.asarray(res.x)[seed_lane][:, jnp.asarray(spec.dynamic_indices)]
-          if use_x0 else None)
-    keys = jax.random.split(jax.random.PRNGKey(seed), len(idx_p))
+    dyn = jnp.asarray(spec.dynamic_indices)
+    x_dtype = jnp.asarray(res.x).dtype
+    sub = _place_subset(mesh, len(idx_p), sub)
+    bsh = _subset_sharding(mesh, len(idx_p))
+    prog = _rescue_program(spec, _pacing_key(opts), bsh)
+    kind = _rescue_kind(opts, bsh)
+    # The pacing/strategy scalars are ()-shaped TRACED arguments --
+    # their VALUES never enter the program key, so every ladder rung
+    # at this bucket shape resolves to the same registered executable.
+    scal = (np.int32(1 if strategy == "lm" else 0), np.bool_(use_x0),
+            np.float64(opts.dt0), np.float64(opts.dt_grow_min),
+            np.int64(opts.max_steps), np.int64(opts.max_attempts))
 
     # Retry on transient compile-service/transport flakes: the rescue
     # program compiles lazily at the failed subset's bucket shape, and
     # one dropped remote-compile connection otherwise kills the whole
     # sweep (the round-4 driver bench died exactly here). The success
     # materialization rides inside the retried unit so execution-time
-    # flakes re-dispatch too.
+    # flakes re-dispatch too. keys and x0 are rebuilt INSIDE the
+    # retried closure: the rescue program donates both buffers, so a
+    # retry must never re-feed consumed arrays.
     def run_rescue():
-        o = _registered_call(spec, _steady_kind(opts, strategy),
-                             _steady_program(spec, opts,
-                                             strategy=strategy),
-                             (sub, keys, x0))
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(idx_p))
+        # x0 is always a CONCRETE array (never a treedef-changing
+        # None): the seeded/unseeded choice is the traced `use_x0`
+        # select inside the program, so both variants share one
+        # executable. The unseeded values are dead (the select takes
+        # the base state) -- zeros keep the dispatch cheap.
+        if use_x0:
+            x0 = jnp.asarray(res.x)[seed_lane][:, dyn]
+        else:
+            x0 = jnp.zeros((len(idx_p), dyn.size), dtype=x_dtype)
+        if mesh is not None:
+            keys, x0 = _place_subset(mesh, len(idx_p), keys, x0)
+        o = _registered_call(spec, kind, prog, (sub, keys, x0) + scal)
         return o, host_sync(o.success,
                             f"rescue[{strategy}]")[:len(idx)]
 
-    out, got = call_with_backend_retry(run_rescue,
-                                       label=f"rescue[{strategy}]")
+    with span(f"rescue[{strategy}]"):
+        out, got = call_with_backend_retry(run_rescue,
+                                           label=f"rescue[{strategy}]")
     n_remaining = int(n_failed - got.sum())
     # Structured evidence of every rescue-pass invocation (bench.py
     # folds the per-trial counts into its report; no sync -- a host
@@ -632,10 +831,20 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
         arr = np.array(cur)
         arr[idx[got]] = np.asarray(new)[:len(idx)][got]  # sync-ok: failure path
         extra[name] = jnp.asarray(arr)
-    return res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
-                        residual=jnp.asarray(resid),
-                        iterations=jnp.asarray(iters),
-                        attempts=jnp.asarray(atts), **extra), n_remaining
+    merged = res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
+                          residual=jnp.asarray(resid),
+                          iterations=jnp.asarray(iters),
+                          attempts=jnp.asarray(atts), **extra)
+    if mesh is not None:
+        # The host-side merge produced unsharded arrays; re-shard so
+        # the downstream tail (screen/TOF) keeps hitting the SHARDED
+        # program keys its prewarmed executables were registered under.
+        n_lanes = len(success)
+        sh = _subset_sharding(mesh, n_lanes)
+        if sh is not None:
+            merged = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), merged)
+    return merged, n_remaining
 
 
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
@@ -660,11 +869,25 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     # ~p99 lane), then host-side rescue of the failed subset with the
     # full retry ladder, then the LM strategy fallback. Stragglers no
     # longer drag every lane through the whole retry ladder.
+    #
+    # With a mesh, the ENTIRE tail is mesh-aware: conds are lane-
+    # sharded up front (so the screen/TOF program keys carry the
+    # sharding fingerprint prewarm registered) and the mesh threads
+    # through the rescue ladder, the stability tiers and the TOF
+    # re-run. Lane counts the mesh cannot divide fall back to the
+    # padded solve + unsharded tail (correct, just not prewarmed).
+    tail_mesh = None
+    if mesh is not None:
+        n = jax.tree_util.tree_leaves(conds)[0].shape[0]
+        if n % mesh.devices.size == 0:
+            conds = shard_conditions(conds, mesh)
+            tail_mesh = mesh
     res = batch_steady_state(spec, conds, x0=x0, opts=_fast_pass_opts(opts),
                              mesh=mesh)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
                          check_stability, pos_jac_tol,
-                         backend=_resolve_backend(mesh=mesh))
+                         backend=_resolve_backend(mesh=mesh),
+                         mesh=tail_mesh)
 
 
 def _quarantine_mask(res, quarantined=None):
@@ -695,7 +918,8 @@ def _tail_bundle(success, quarantined, ambiguous, demoted, n_neg):
 
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                   opts: SolverOptions, tof_mask, check_stability: bool,
-                  pos_jac_tol: float, backend: Optional[str] = None):
+                  pos_jac_tol: float, backend: Optional[str] = None,
+                  mesh: Optional[Mesh] = None):
     """Shared sweep tail: quarantine, rescue ladder, stability
     verdict/demote loop, TOF/activity -- everything downstream of the
     first solving pass (used by both sweep_steady_state and
@@ -715,8 +939,17 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     screen reused when the ladder did not run (res.x unchanged).
     """
     backend = _resolve_backend(backend)
+    sh_full = None
+    if mesh is not None:
+        sh_full = _subset_sharding(
+            mesh, jax.tree_util.tree_leaves(conds)[0].shape[0])
     res, quar = _quarantine_mask(res)
     succ0 = jnp.asarray(res.success)
+    if sh_full is not None:
+        # Pin the DERIVED masks' layout: eager elementwise ops on
+        # sharded inputs leave the output sharding to the compiler,
+        # and the screen/TOF program keys fingerprint it.
+        succ0 = jax.device_put(succ0, sh_full)
     mask_arr = jnp.asarray(tof_mask) if tof_mask is not None else None
 
     def run_tail():
@@ -731,6 +964,8 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                 _stability_screen_program(spec, pos_jac_tol, backend),
                 (conds, res.x, succ0))
             ok_spec = succ0 & cert
+            if sh_full is not None:
+                ok_spec = jax.device_put(ok_spec, sh_full)
             demoted = succ0 & ~cert
         else:
             ok_spec = succ0
@@ -744,8 +979,9 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         return (cert, amb, n_amb_dev, tofs, act,
                 host_sync(bundle, "sweep tail bundle"))
 
-    cert, amb, n_amb_dev, tofs, act, counts = call_with_backend_retry(
-        run_tail, label="sweep tail")
+    with span("sweep tail"):
+        cert, amb, n_amb_dev, tofs, act, counts = call_with_backend_retry(
+            run_tail, label="sweep tail")
     nf, nq, n_amb, n_dem, n_neg = (int(c) for c in counts)
 
     if nf == 0 and (not check_stability
@@ -790,12 +1026,13 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         # _polish_opts). The full ladder and the LM strategy remain
         # behind it for whatever survives.
         res, nf = _rescue(spec, conds, res, _polish_opts(opts), "ptc",
-                          neighbor_seed=True, n_failed=nf)
+                          neighbor_seed=True, n_failed=nf, mesh=mesh)
     if nf > 0:
         res, nf = _rescue(spec, conds, res, opts, "ptc",
-                          neighbor_seed=True, n_failed=nf)
+                          neighbor_seed=True, n_failed=nf, mesh=mesh)
     if nf > 0:
-        res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf)
+        res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf,
+                          mesh=mesh)
     if nf0 > 0:
         # Re-check after the ladder: a poisoned RESCUE dispatch can
         # write fresh non-finite "successes" (fault sites rescue[*]);
@@ -816,7 +1053,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         pre = ((cert, amb, n_amb) if nf0 == 0 else None)
         stable = stability_mask(spec, conds, res.x, pos_tol=pos_jac_tol,
                                 ok=res.success, backend=backend,
-                                precomputed=pre)
+                                precomputed=pre, mesh=mesh)
         # Converged-but-UNSTABLE lanes (e.g. the middle root of a
         # bistable mechanism) get the facade's random-restart treatment
         # (api/system.py find_steady: up to 3 retries from fresh
@@ -833,10 +1070,11 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
             res = res._replace(
                 success=jnp.asarray(res.success) & stable)
             res, _ = _rescue(spec, conds, res, opts, "ptc",
-                             seed=17 + round_i, use_x0=False)
+                             seed=17 + round_i, use_x0=False, mesh=mesh)
             stable = stability_mask(spec, conds, res.x,
                                     pos_tol=pos_jac_tol,
-                                    ok=res.success, backend=backend)
+                                    ok=res.success, backend=backend,
+                                    mesh=mesh)
     out = {"y": res.x, "success": res.success, "residual": res.residual,
            "iterations": res.iterations, "attempts": res.attempts,
            "quarantined": quar}
@@ -853,6 +1091,8 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     if tof_mask is not None:
         tprog = _tof_program(spec)
         ok_arr = jnp.asarray(out["success"])
+        if sh_full is not None:
+            ok_arr = jax.device_put(ok_arr, sh_full)
 
         def run_tof():
             # The n_neg materialization doubles as the execution sync
@@ -862,8 +1102,9 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                                          ok_arr))
             return t, a, int(host_sync(nn, "tof sign check"))
 
-        tofs, act, n_neg = call_with_backend_retry(run_tof,
-                                                   label="tof/activity")
+        with span("tof/activity"):
+            tofs, act, n_neg = call_with_backend_retry(
+                run_tof, label="tof/activity")
         out["tof"] = tofs
         out["activity"] = act
         # Deterministic host-side sign check (NOT an async device
@@ -912,11 +1153,18 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     first = _fast_pass_opts(opts)
     cont = stage_opts or opts._replace(dt0=1.0, dt_grow_min=10.0,
                                        max_steps=60, max_attempts=1)
-    keys = jax.random.split(jax.random.PRNGKey(0), n_stages * m)
-
     subs = [jax.tree_util.tree_map(lambda a: jnp.asarray(a)[order[s]],
                                    conds)
             for s in range(n_stages)]
+
+    def stage_keys(s):
+        # Rebuilt per dispatch (and per retry): the stage program
+        # donates its key buffer, and slicing the one full split keeps
+        # the key VALUES identical to the pre-donation behavior (the
+        # prefix stability of jax.random.split is not relied upon).
+        return jax.random.split(jax.random.PRNGKey(0),
+                                n_stages * m)[s * m:(s + 1) * m]
+
     # Stage dispatches ride the retry for compile-time flakes only: a
     # per-stage materialization would serialize the host into the
     # stage chain and destroy the on-device x0 pipelining this function
@@ -924,14 +1172,15 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     # scalar check; callers needing full execution-retry coverage can
     # re-invoke (the sweep is pure).
     stage_res = [None] * n_stages
+    first_prog = _steady_program(spec, first)
     stage_res[0] = call_with_backend_retry(
-        _steady_program(spec, first), subs[0], keys[:m], None,
+        lambda: first_prog(subs[0], stage_keys(0), None),
         label="continuation stage 0")
     prog = _steady_program(spec, cont)
     for s in range(1, n_stages):
         x0 = stage_res[s - 1].x[:, dyn]
         stage_res[s] = call_with_backend_retry(
-            prog, subs[s], keys[s * m:(s + 1) * m], x0,
+            lambda s=s, x0=x0: prog(subs[s], stage_keys(s), x0),
             label=f"continuation stage {s}")
 
     # Reassemble into original lane order (pure device ops).
@@ -984,6 +1233,27 @@ class PrewarmStats(int):
     cache: dict = {}
 
 
+def prewarm_program_count(buckets=(64, 128, 256), aot_buckets=(),
+                          tier2_buckets=(), tier2_aot_buckets=(),
+                          tof: bool = True,
+                          check_stability: bool = True) -> int:
+    """Programs a :func:`prewarm_sweep_programs` call with this layout
+    ensures, WITHOUT compiling anything: fast pass + screen (when
+    stability is on) + TOF (when a mask is given) + ONE consolidated
+    rescue program per solve bucket + one subset-Jacobian program per
+    tier-2 bucket. ``bench.py --smoke`` holds the production layout to
+    ``PREWARM_PROGRAM_BUDGET`` through this arithmetic (the full bench
+    is too expensive for the CI lane to actually prewarm)."""
+    n = 1                                     # full-shape fast pass
+    if check_stability:
+        n += 1                                # stability screen
+    if tof:
+        n += 1                                # TOF/activity
+    n += len(set(buckets) | set(aot_buckets))          # rescue
+    n += len(set(tier2_buckets) | set(tier2_aot_buckets))  # tier-2 jac
+    return n
+
+
 def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            tof_mask=None,
                            opts: SolverOptions = SolverOptions(),
@@ -995,7 +1265,8 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                            pos_jac_tol: float = 1e-2,
                            verbose: bool = False,
                            cache=None,
-                           workers: int | None = None):
+                           workers: int | None = None,
+                           mesh: Optional[Mesh] = None):
     """Compile (or load from the on-disk AOT executable cache) every
     program :func:`sweep_steady_state` can touch at this lane count, up
     to rescue/ambiguous subsets of ``max(buckets + aot_buckets)`` lanes.
@@ -1007,40 +1278,56 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     remote compile (plus its transport flake risk, the round-4 bench
     crash) inside a timed trial or a production solve. One call here
     front-loads: the full-shape fast pass, the screen, the TOF/activity
-    program, and per pow2 bucket the PTC/LM rescue (seeded and
-    unseeded) plus the subset Jacobian.
+    program, ONE consolidated rescue program per pow2 solve bucket
+    (strategy/seededness/pacing are runtime arguments of
+    :func:`_rescue_program` -- the r05 zoo's four per-bucket variants
+    collapsed into it), and the subset Jacobian at the ``tier2_*``
+    shapes only.
 
-    Pipelined execution (vs the r05 sequential loop, 136.6 s for 32
-    programs): every ``.lower().compile()`` not satisfied by the AOT
-    cache is submitted to a bounded thread pool
-    (:func:`compile_pool.map_compile`; XLA compiles release the GIL),
-    the resulting executables are serialized into the cache
+    Compile/fast-pass OVERLAP (vs the r05 sequential loop, 136.6 s for
+    32 programs): the tail-program job list is built from ABSTRACT
+    result shapes (``jax.eval_shape`` on the fast pass -- no execution
+    needed), so every ``.lower().compile()`` not satisfied by the AOT
+    cache is submitted to the compile pool
+    (:func:`compile_pool.submit_compile`; XLA compiles release the GIL)
+    BEFORE the fast pass executes, and runs concurrently with it.
+    Resulting executables are serialized into the cache
     (:class:`compile_pool.AOTCache` -- a restarted process deserializes
     instead of compiling) and published in the process-wide registry
     that the sweep hot path consults, so warmed programs are what a
-    sweep actually runs.
+    sweep actually runs. Set ``PYCATKIN_PREWARM_OVERLAP=0`` to
+    serialize (compile first, then execute) for debugging.
 
     ``buckets`` are compiled AND executed once (runtime paging and
     dispatch paths then fully hot); ``aot_buckets`` are compiled/loaded
     only -- cheaper to warm; a later in-band hit executes the
     registered AOT executable with no trace or compile.
-    ``tier2_buckets`` warm (execute) ONLY the subset-Jacobian program
-    at additional shapes -- the stability tier-2's ambiguous subset
-    follows a different count distribution than the rescue's failed
-    subset, and it is BACKEND-dependent: the Lyapunov certificate's
-    error margin tracks the backend's unit roundoff, so it abstains on
-    <~1 % of volcano lanes on true-f64 CPU but ~14 % on the emulated-
-    f64 TPU (measured: warmup and trial ambiguous counts both ~9.5k ->
-    bucket 16384). Put the production backend's likely shapes here and
-    other scales in ``tier2_aot_buckets``. A sweep whose failed subset
-    pads beyond the largest bucket still compiles in-band.
+    ``tier2_buckets`` warm (execute) the subset-Jacobian program --
+    the stability tier-2's ambiguous subset follows a different count
+    distribution than the rescue's failed subset (floored at
+    ``TIER2_MIN_BUCKET`` on the hot path), and it is BACKEND-dependent:
+    the Lyapunov certificate's error margin tracks the backend's unit
+    roundoff, so it abstains on <~1 % of volcano lanes on true-f64 CPU
+    but ~14 % on the emulated-f64 TPU (measured: warmup and trial
+    ambiguous counts both ~9.5k -> bucket 16384). Put the production
+    backend's likely shapes here and other scales in
+    ``tier2_aot_buckets``. A sweep whose failed subset pads beyond the
+    largest bucket still compiles in-band.
+
+    ``mesh``: prewarm the SHARDED program variants a
+    ``sweep_steady_state(mesh=...)`` call will dispatch -- conds are
+    lane-sharded up front and every program key carries the sharding
+    fingerprint, so mesh and single-device executables never collide
+    in the registry or the AOT cache.
 
     ``cache``: an :class:`compile_pool.AOTCache` (None builds one from
     ``PYCATKIN_AOT_CACHE`` bound to this spec's fingerprint; False
     disables the disk layer). ``workers``: compile-pool width (None
     reads ``PYCATKIN_COMPILE_WORKERS``).
 
-    Returns a :class:`PrewarmStats` (an ``int``: programs touched).
+    Returns a :class:`PrewarmStats` (an ``int``: programs touched --
+    bounded by ``PREWARM_PROGRAM_BUDGET`` for the production bench
+    layout, asserted by ``bench.py --smoke``).
     Every compile/load/execute rides the transient-error retry, so a
     flake can never escape to the caller's timed region.
     """
@@ -1083,20 +1370,26 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         return key, False
 
     def _compile_and_publish(job):
-        """Pool task: compile one program, serialize + register it."""
+        """Pool task: compile one program, serialize + register it.
+        Cache entries record the argument sharding fingerprint, so a
+        sharded executable is never deserialized into a process whose
+        device population cannot satisfy it (silent miss, recompile)."""
         exe = call_with_backend_retry(
             lambda: job["prog"].lower(*job["args"]).compile(),
             label=f"compile:{job['label']}")
-        cache.save(job["key"], exe)
+        cache.save(job["key"], exe,
+                   sharding=compile_pool.args_sharding_fingerprint(
+                       job["args"]))
         compile_pool.register(spec, job["key"], exe)
         return exe
 
     n_compiled = 0
     n_loaded = 0
 
-    def _ensure(jobs_batch):
-        """Load-or-compile a batch of jobs concurrently."""
-        nonlocal n_compiled, n_loaded
+    def _partition(jobs_batch):
+        """Resolve each job against the registry/AOT cache; return the
+        jobs that still need a fresh compile."""
+        nonlocal n_loaded
         to_compile = []
         for job in jobs_batch:
             key, have = _resolve(job["kind"], job["prog"], job["args"],
@@ -1106,6 +1399,12 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                 n_loaded += 1
             else:
                 to_compile.append(job)
+        return to_compile
+
+    def _ensure(jobs_batch):
+        """Load-or-compile a batch of jobs concurrently (blocking)."""
+        nonlocal n_compiled
+        to_compile = _partition(jobs_batch)
         if to_compile:
             t0 = _time.perf_counter()
             compile_pool.map_compile(
@@ -1117,119 +1416,183 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
 
     leaves = jax.tree_util.tree_leaves(conds)
     n = leaves[0].shape[0]
-    keys_full = jax.random.split(jax.random.PRNGKey(0), n)
-    backend = _resolve_backend()
+    sharding = _subset_sharding(mesh, n)
+    if sharding is not None:
+        conds = jax.device_put(conds, sharding)
+    backend = _resolve_backend(mesh=mesh)
+    dyn = jnp.asarray(spec.dynamic_indices)
 
-    # --- the fast pass first: its solutions seed every later shape ---
-    fast_kind = _steady_kind(_fast_pass_opts(opts), "ptc")
-    fast_prog = _steady_program(spec, _fast_pass_opts(opts))
+    def _keys_full():
+        # Rebuilt per dispatch: the solve programs donate their key
+        # buffer, so a retried run must never re-feed a consumed array.
+        k = jax.random.split(jax.random.PRNGKey(0), n)
+        return jax.device_put(k, sharding) if sharding is not None else k
+
+    # --- the fast pass program first (blocking: everything else's
+    # result shapes derive from it) ---
+    fast_kind = _steady_kind(_fast_pass_opts(opts), "ptc", sharding)
+    fast_prog = _steady_program(spec, _fast_pass_opts(opts), sharding)
     fast_job = {"kind": fast_kind, "prog": fast_prog,
-                "args": (conds, keys_full, None),
+                "args": (conds, _keys_full(), None),
                 "label": f"fast pass @{n}"}
     _ensure([fast_job])
 
-    def run_fast():
-        r = _registered_call(spec, fast_kind, fast_prog,
-                             (conds, keys_full, None))
-        np.asarray(jnp.sum(r.residual))      # sync inside the retry
-        return r
+    # --- build the FULL job list from abstract result shapes: no
+    # execution has happened yet, so the tail compiles can overlap the
+    # fast pass below. ys-dependent arguments enter the jobs as
+    # jax.ShapeDtypeStruct (lower() and program_key() only consume
+    # shape/dtype/sharding); phase C builds the concrete arrays. ---
+    shapes = jax.eval_shape(fast_prog, conds, _keys_full(), None)
+    x_dtype = shapes.x.dtype
+    n_species = shapes.x.shape[1]
 
-    res = timed_retry(run_fast, f"fast pass @{n}")
-    ys = res.x
-    n_executed = 1
-    dyn = jnp.asarray(spec.dynamic_indices)
+    def _sds(shape, dtype, bsh=None):
+        if bsh is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
 
-    # --- build the full job list (args depend on ys) ---
     jobs: list[dict] = []
     seen_keys: set = set()
 
-    def _add(kind, prog, args, label, execute, fence):
+    def _add(kind, prog, args, label, execute, fence, exec_args=None):
         # Dedup on the program key: e.g. the same jac bucket named in
-        # both `buckets` and `tier2_buckets` compiles/executes once.
+        # both `tier2_buckets` and `tier2_aot_buckets` once.
         key = compile_pool.program_key(kind, args)
         if key in seen_keys:
             return
         seen_keys.add(key)
         jobs.append({"kind": kind, "prog": prog, "args": args,
                      "label": label, "execute": execute,
-                     "fence": fence, "key": key})
+                     "fence": fence, "key": key,
+                     "exec_args": exec_args})
 
     solve_fence = lambda r: jnp.sum(r.residual)           # noqa: E731
     scalar2_fence = lambda out: out[2]                    # noqa: E731
     jac_fence = lambda J: jnp.sum(                        # noqa: E731
         jnp.where(jnp.isfinite(J), J, 0.0))
 
+    x_abs = _sds((n, n_species), x_dtype, sharding)
+    ok_full = jnp.ones(n, dtype=bool)
+    if sharding is not None:
+        ok_full = jax.device_put(ok_full, sharding)
     if check_stability:
         _add(_screen_kind(pos_jac_tol, backend),
              _stability_screen_program(spec, pos_jac_tol, backend),
-             (conds, ys, jnp.ones(n, dtype=bool)),
-             f"stability screen @{n}", True, scalar2_fence)
+             (conds, x_abs, ok_full),
+             f"stability screen @{n}", True, scalar2_fence,
+             exec_args=lambda res: (conds, res.x, ok_full))
     if tof_mask is not None:
+        mask_arr = jnp.asarray(tof_mask)
         _add("tof", _tof_program(spec),
-             (conds, ys, jnp.asarray(tof_mask),
-              jnp.ones(n, dtype=bool)),
-             f"tof/activity @{n}", True, scalar2_fence)
+             (conds, x_abs, mask_arr, ok_full),
+             f"tof/activity @{n}", True, scalar2_fence,
+             exec_args=lambda res: (conds, res.x, mask_arr, ok_full))
 
-    def _bucket_args(b):
+    def _bucket_conds(b):
         idx = np.arange(b) % n
         sub = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx],
                                      conds)
-        keys = jax.random.split(jax.random.PRNGKey(1), b)
-        x0 = jnp.asarray(ys)[idx][:, dyn]
-        return sub, keys, x0, jnp.asarray(ys)[idx]
+        return idx, _place_subset(mesh, b, sub)
 
-    def _add_solve_bucket(b, execute):
-        sub, keys, x0, _ = _bucket_args(b)
+    def _add_rescue_bucket(b, execute):
+        # ONE consolidated program covers the whole ladder at this
+        # bucket: polish / full PTC / LM / unseeded demote re-solve
+        # are runtime scalars of _rescue_program, and the scalars'
+        # VALUES never enter the program key -- so the four r05
+        # variants share this single compile.
+        idx, sub = _bucket_conds(b)
+        bsh = _subset_sharding(mesh, b)
+        keys_b = jax.random.split(jax.random.PRNGKey(1), b)
+        if mesh is not None:
+            keys_b = _place_subset(mesh, b, keys_b)
+        scal = (np.int32(0), np.bool_(True),
+                np.float64(opts.dt0), np.float64(opts.dt_grow_min),
+                np.int64(opts.max_steps), np.int64(opts.max_attempts))
         tag = "" if execute else "aot "
-        # Seeded near-Newton polish (the first rescue pass). The
-        # strategy kwarg must match _rescue's call pattern exactly:
-        # lru_cache keys on the literal call signature, so an omitted
-        # default here would warm a DIFFERENT jit object than the one
-        # the sweep executes.
-        _add(_steady_kind(_polish_opts(opts), "ptc"),
-             _steady_program(spec, _polish_opts(opts), strategy="ptc"),
-             (sub, keys, x0), f"{tag}polish @{b}", execute, solve_fence)
-        for strat in ("ptc", "lm"):
-            _add(_steady_kind(opts, strat),
-                 _steady_program(spec, opts, strategy=strat),
-                 (sub, keys, x0), f"{tag}rescue[{strat}] @{b}",
-                 execute, solve_fence)
-        # The stability demote loop rescues with use_x0=False ->
-        # x0=None, a DIFFERENT traced program than the seeded variant.
-        _add(_steady_kind(opts, "ptc"),
-             _steady_program(spec, opts, strategy="ptc"),
-             (sub, keys, None), f"{tag}rescue[ptc,unseeded] @{b}",
-             execute, solve_fence)
-        if check_stability:
-            _add_jac(b, execute)
+
+        def exec_args(res, b=b, idx=idx, sub=sub, scal=scal):
+            keys = jax.random.split(jax.random.PRNGKey(1), b)
+            x0 = jnp.asarray(res.x)[idx][:, dyn]
+            if mesh is not None:
+                keys, x0 = _place_subset(mesh, b, keys, x0)
+            return (sub, keys, x0) + scal
+
+        _add(_rescue_kind(opts, bsh),
+             _rescue_program(spec, _pacing_key(opts), bsh),
+             (sub, keys_b, _sds((b, int(dyn.size)), x_dtype, bsh))
+             + scal,
+             f"{tag}rescue @{b}", execute, solve_fence, exec_args)
 
     def _add_jac(b, execute):
-        sub, _, _, ysub = _bucket_args(b)
+        idx, sub = _bucket_conds(b)
+        bsh = _subset_sharding(mesh, b)
         tag = "" if execute else "aot "
-        _add("jac", _jacobian_program(spec), (sub, ysub),
-             f"{tag}tier-2 jac @{b}", execute, jac_fence)
+
+        def exec_args(res, b=b, idx=idx, sub=sub):
+            ysub = jnp.asarray(res.x)[idx]
+            if mesh is not None:
+                ysub = _place_subset(mesh, b, ysub)
+            return (sub, ysub)
+
+        _add("jac", _jacobian_program(spec),
+             (sub, _sds((b, n_species), x_dtype, bsh)),
+             f"{tag}tier-2 jac @{b}", execute, jac_fence, exec_args)
 
     for b in buckets:
-        _add_solve_bucket(b, True)
+        _add_rescue_bucket(b, True)
+    for b in aot_buckets:
+        _add_rescue_bucket(b, False)
     if check_stability:
+        # Jacobian shapes come from the tier2 knobs ONLY: the hot
+        # path's TIER2_MIN_BUCKET floor makes small jac shapes
+        # unreachable, so warming one per solve bucket (the r05
+        # layout) paid compiles the sweep could never hit.
         for b in tier2_buckets:
             _add_jac(b, True)
         for b in tier2_aot_buckets:
             _add_jac(b, False)
-    for b in aot_buckets:
-        _add_solve_bucket(b, False)
 
-    # --- phase B: satisfy every job from cache or the compile pool ---
-    _ensure(jobs)
+    def run_fast():
+        r = _registered_call(spec, fast_kind, fast_prog,
+                             (conds, _keys_full(), None))
+        np.asarray(jnp.sum(r.residual))      # sync inside the retry
+        return r
 
-    # --- phase C: run the executed buckets once (device is serial) ---
+    # --- phase B: satisfy every tail job from cache or the compile
+    # pool, OVERLAPPED with the fast-pass execution (compiles release
+    # the GIL; the device runs the fast pass while host threads
+    # compile the tail). PYCATKIN_PREWARM_OVERLAP=0 serializes. ---
+    overlap = os.environ.get("PYCATKIN_PREWARM_OVERLAP", "1").strip() \
+        .lower() not in ("0", "off", "none", "disabled", "false")
+    if overlap:
+        to_compile = _partition(jobs)
+        t0 = _time.perf_counter()
+        pending = compile_pool.submit_compile(
+            [lambda j=job: _compile_and_publish(j)
+             for job in to_compile], workers)
+        res = timed_retry(run_fast, f"fast pass @{n}")
+        pending.wait()
+        if to_compile:
+            n_compiled += len(to_compile)
+            _log(f"compiled {len(to_compile)} program(s) overlapped "
+                 f"with the fast pass in "
+                 f"{_time.perf_counter() - t0:.2f} s")
+    else:
+        _ensure(jobs)
+        res = timed_retry(run_fast, f"fast pass @{n}")
+    n_executed = 1
+
+    # --- phase C: run the executed buckets once (device is serial),
+    # with concrete arguments built fresh INSIDE each retried unit
+    # (the rescue program donates keys and x0). ---
     for job in jobs:
         if not job["execute"]:
             continue
 
         def run(j=job):
-            out = _registered_call(spec, j["kind"], j["prog"],
-                                   j["args"])
+            args = (j["exec_args"](res) if j["exec_args"] is not None
+                    else j["args"])
+            out = _registered_call(spec, j["kind"], j["prog"], args)
             np.asarray(j["fence"](out))      # sync inside the retry
             return out
 

@@ -99,31 +99,74 @@ def spec_fingerprint(spec) -> str:
     return h.hexdigest()
 
 
+# Bump to invalidate every previously-written AOT cache entry as a
+# plain (silent) miss. v2: program keys carry the per-argument sharding
+# fingerprint, so executables compiled for a sharded mesh layout can be
+# cached and looked up without ever colliding with the single-device
+# entries of the same shapes.
+_KEY_VERSION = "aot-key-v2"
+
+
+def _leaf_sharding_tag(leaf) -> str:
+    """Sharding fingerprint of one argument leaf: non-empty only for a
+    leaf placed (or abstractly declared, via ``jax.ShapeDtypeStruct``'s
+    ``sharding=``) under a multi-device ``NamedSharding``. Host numpy
+    arrays and single-device jax arrays contribute the empty string, so
+    unsharded program keys are unaffected by this dimension."""
+    sh = getattr(leaf, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if sh is None or spec is None:
+        return ""
+    try:
+        sizes = tuple(sh.mesh.shape.items())
+    except Exception:
+        return ""
+    if all(s <= 1 for _, s in sizes):
+        return ""
+    axes = ";".join(f"{n}={s}" for n, s in sizes)
+    return f"@[{axes}]{spec}"
+
+
+def args_sharding_fingerprint(args) -> str:
+    """Joined sharding tags of every argument leaf ('' when fully
+    unsharded) -- recorded in AOT cache entries so a sharded executable
+    is never deserialized into a process with a different device
+    population."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    tags = [_leaf_sharding_tag(leaf) for leaf in leaves]
+    return "|".join(tags) if any(tags) else ""
+
+
 def _shape_signature(args) -> str:
-    """Deterministic (treedef, dtype, shape) signature of a concrete
-    argument tuple -- what a compiled executable is specialized on.
-    ``None`` subtrees are part of the treedef, so seeded (x0 array) and
-    unseeded (x0=None) variants of the same program get distinct keys."""
+    """Deterministic (treedef, dtype, shape, sharding) signature of a
+    concrete argument tuple -- what a compiled executable is
+    specialized on. ``None`` subtrees are part of the treedef, so
+    seeded (x0 array) and unseeded (x0=None) variants of the same
+    program get distinct keys; sharded leaves carry their mesh/spec
+    fingerprint so mesh and single-device programs never collide."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(args)
     parts = [repr(treedef)]
     for leaf in leaves:
         a = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
-        parts.append(f"{a.dtype}{tuple(a.shape)}")
+        parts.append(f"{a.dtype}{tuple(a.shape)}"
+                     f"{_leaf_sharding_tag(leaf)}")
     return "|".join(parts)
 
 
 def program_key(kind: str, args) -> str:
     """Stable cache/registry key for one compiled program: the program
     *kind* (strategy + solver-options repr, from the caller), the
-    argument shape signature, and the executing toolchain (backend,
-    device kind, jax version)."""
+    argument shape+sharding signature, and the executing toolchain
+    (backend, device kind, jax version, key-format version)."""
     import jax
 
     dev = jax.devices()[0]
-    mat = "\x1f".join([kind, _shape_signature(args), dev.platform,
-                       dev.device_kind, jax.__version__])
+    mat = "\x1f".join([_KEY_VERSION, kind, _shape_signature(args),
+                       dev.platform, dev.device_kind, jax.__version__])
     return hashlib.sha256(mat.encode()).hexdigest()[:32]
 
 
@@ -228,6 +271,15 @@ class AOTCache:
                 or entry.get("device_kind") != dev.device_kind):
             self.misses += 1            # stale toolchain: plain miss
             return None
+        # A sharded executable bakes in its mesh's device assignment;
+        # deserializing it into a process with a different device
+        # population would fail (or worse, misplace shards) at call
+        # time. Different population = plain miss, like a toolchain
+        # change -- only the spec fingerprint is a hard error.
+        if entry.get("sharding") and \
+                entry.get("devices") != jax.device_count():
+            self.misses += 1
+            return None
         if entry.get("fingerprint") != self.fingerprint:
             self.mismatches += 1
             raise CacheMismatch(
@@ -245,11 +297,15 @@ class AOTCache:
         self.hits += 1
         return exe
 
-    def save(self, key: str, compiled) -> bool:
+    def save(self, key: str, compiled, sharding: str = "") -> bool:
         """Serialize ``compiled`` (a jax ``Compiled``) under ``key``.
-        Returns True on success; serialization failures (unsupported
-        backend, unpicklable treedefs, full disk) degrade to False --
-        the in-process registry still carries the executable."""
+        ``sharding``: the :func:`args_sharding_fingerprint` of the
+        arguments the program was compiled for ('' for single-device
+        programs); sharded entries additionally record the device
+        population they are valid on. Returns True on success;
+        serialization failures (unsupported backend, unpicklable
+        treedefs, full disk) degrade to False -- the in-process
+        registry still carries the executable."""
         if not self.enabled:
             return False
         import jax
@@ -262,6 +318,8 @@ class AOTCache:
                      "jax": jax.__version__,
                      "backend": dev.platform,
                      "device_kind": dev.device_kind,
+                     "sharding": str(sharding),
+                     "devices": jax.device_count(),
                      "payload": payload,
                      "in_tree": in_tree,
                      "out_tree": out_tree}
@@ -280,6 +338,53 @@ class AOTCache:
         return {"root": self.root or None, "hits": self.hits,
                 "misses": self.misses, "writes": self.writes,
                 "mismatches": self.mismatches}
+
+
+class PendingCompiles:
+    """Handle for an in-flight :func:`submit_compile` batch. ``wait()``
+    blocks until every task finished, shuts the pool down, and returns
+    the results in submission order (re-raising the first failure, like
+    :func:`map_compile`). Width 1 degenerates to running the tasks
+    serially inside ``wait()`` -- submission then costs nothing and no
+    compile overlaps the caller's work, which is exactly the
+    ``PYCATKIN_COMPILE_WORKERS=1`` sequential contract."""
+
+    def __init__(self, tasks, workers: int):
+        self._tasks = list(tasks)
+        self._executor = None
+        self._futures = []
+        if workers > 1 and len(self._tasks) > 1:
+            self._executor = ThreadPoolExecutor(
+                max_workers=min(workers, len(self._tasks)))
+            self._futures = [self._executor.submit(t)
+                             for t in self._tasks]
+
+    def wait(self):
+        if self._executor is None:
+            return [t() for t in self._tasks]
+        results = [None] * len(self._futures)
+        errors: list[BaseException] = []
+        try:
+            for i, fut in enumerate(self._futures):
+                try:
+                    results[i] = fut.result()
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    errors.append(e)
+        finally:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        if errors:
+            raise errors[0]
+        return results
+
+
+def submit_compile(tasks, workers: int | None = None) -> PendingCompiles:
+    """Non-blocking :func:`map_compile`: start ``tasks`` on the pool and
+    return immediately with a :class:`PendingCompiles` handle. XLA
+    compiles release the GIL, so the caller can execute device programs
+    (e.g. the sweep's first fast pass) while the tail programs compile
+    concurrently."""
+    return PendingCompiles(tasks, workers or compile_workers())
 
 
 def map_compile(tasks, workers: int | None = None):

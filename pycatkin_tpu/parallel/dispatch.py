@@ -184,12 +184,14 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
             f"dispatch_sweep: worker block(s) {failed} failed or timed "
             f"out; inputs and any partial results are in {work_dir}")
 
+    from ..utils.profiling import span
     merged: dict = {}
-    for i, out_path, _ in procs:
-        with np.load(out_path) as z:
-            for key in z.files:
-                merged.setdefault(key, []).append(z[key])
-    out = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+    with span("dispatch merge", n_blocks=len(procs)):
+        for i, out_path, _ in procs:
+            with np.load(out_path) as z:
+                for key in z.files:
+                    merged.setdefault(key, []).append(z[key])
+        out = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
     if own_dir:
         # Self-created scratch only; caller-supplied work_dirs (and any
         # failure, which raises above) are left in place for debugging.
@@ -226,11 +228,16 @@ def _worker(cfg_path: str, inject_faults: bool = True) -> None:
     # worker fleet from redundantly recompiling what one run already
     # built (the cache dir arrives via PYCATKIN_AOT_CACHE).
     from .batch import warm_from_aot_cache
-    warm_from_aot_cache(sim.spec, conds, tof_mask=mask,
-                        check_stability=cfg.get("check_stability", False))
-    out = sweep_steady_state(sim.spec, conds, tof_mask=mask,
-                             check_stability=cfg.get("check_stability",
-                                                     False))
+    from ..utils.profiling import span
+    block = cfg.get("block", 0)
+    with span("worker aot warm", block=block):
+        warm_from_aot_cache(sim.spec, conds, tof_mask=mask,
+                            check_stability=cfg.get("check_stability",
+                                                    False))
+    with span("worker sweep", block=block):
+        out = sweep_steady_state(sim.spec, conds, tof_mask=mask,
+                                 check_stability=cfg.get(
+                                     "check_stability", False))
     np.savez_compressed(cfg["out"],
                         **{k: np.asarray(v) for k, v in out.items()})
 

@@ -84,7 +84,8 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
                                resume: bool = False,
                                policy: DegradationPolicy | None = None,
                                verbose: bool = False,
-                               pipeline: bool = True):
+                               pipeline: bool = True,
+                               mesh=None):
     """Run ``sweep_steady_state`` chunk by chunk with journaling and
     graceful degradation.
 
@@ -119,6 +120,13 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     runner degrades to the serial loop automatically under an active
     fault-injection plan (whose per-site occurrence drills assume
     solve and triage interleave strictly).
+
+    ``mesh``: a ``jax.sharding.Mesh`` forwarded to every per-chunk
+    ``sweep_steady_state`` call -- each chunk's lanes are sharded
+    across it (chunk sizes the mesh cannot divide fall back to the
+    unsharded path inside the sweep, chunk by chunk). Not compatible
+    with the ladder's single-device fallback rungs, which pin a
+    ``jax.default_device``; those rungs drop the mesh.
     """
     import jax
     import jax.numpy as jnp
@@ -157,11 +165,14 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
             ctx = (jax.default_device(device) if device is not None
                    else nullcontext())
             with ctx:
+                # A ladder rung that pins a fallback device cannot
+                # also shard across the mesh -- drop it for that rung.
                 out = sweep_steady_state(
                     spec, jax.tree_util.tree_map(jnp.asarray, _sub),
                     tof_mask=tof_mask, opts=opts,
                     check_stability=check_stability,
-                    pos_jac_tol=pos_jac_tol)
+                    pos_jac_tol=pos_jac_tol,
+                    mesh=(mesh if device is None else None))
                 out = {k: np.asarray(v) for k, v in out.items()}
             return faults.transform(_site, out)
 

@@ -455,7 +455,12 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     exited with.
     """
     attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
-    if opts.max_attempts == 1:
+    # The consolidated rescue program passes pacing knobs (dt0,
+    # max_steps, max_attempts, ...) as traced values so one compiled
+    # program serves every ladder rung; a traced max_attempts must take
+    # the general retry loop below (whose while_loop condition handles
+    # tracers), and only a static ==1 may select the dedicated path.
+    if isinstance(opts.max_attempts, int) and opts.max_attempts == 1:
         # Dedicated single-attempt path (the batched sweep's capped
         # first pass): no retry while_loop, no PRNG restart machinery,
         # no multi-attempt scoreboard -- a measurably smaller compiled
@@ -544,7 +549,7 @@ def deflation_basis(groups_dyn) -> "np.ndarray":
     (static per spec; the result enters jitted programs as a
     constant)."""
     import numpy as np
-    G = np.asarray(groups_dyn, dtype=np.float64)
+    G = np.asarray(groups_dyn, dtype=float)
     G = G[(G > 0).any(axis=1)] if G.size else G.reshape(0, G.shape[-1])
     n = np.asarray(groups_dyn).shape[-1]
     if G.shape[0] == 0:
@@ -695,7 +700,7 @@ def stability_tolerance_from_scale(scale, pos_tol: float = 1e-2,
     :func:`stability_tolerance` for the rationale."""
     import numpy as np
     if eps is None:
-        eps = float(np.finfo(getattr(scale, "dtype", np.float64)).eps)
+        eps = float(np.finfo(getattr(scale, "dtype", float)).eps)
     return pos_tol + 64.0 * eps * scale
 
 

@@ -60,6 +60,27 @@ def drain_events() -> list:
     return out
 
 
+@contextmanager
+def span(label: str, **fields):
+    """Wall-clock one region of the sweep hot path into the event log::
+
+        with span("rescue pass", strategy="ptc"):
+            ...
+
+    Records ONE ``{"kind": "span", "label": label, "dur": seconds}``
+    event on exit (exceptions included -- a span that died still shows
+    how long it ran). Spans are the variance-forensics primitive:
+    bench.py diffs per-trial span events to attribute slow-trial
+    outliers to a named region (dispatch, rescue pass, tail sync,
+    in-band compile) instead of guessing from total walls."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_event("span", label=str(label),
+                     dur=round(time.perf_counter() - t0, 6), **fields)
+
+
 # ---------------------------------------------------------------------
 # Host-sync accounting. Every BLOCKING device->host materialization on
 # the sweep hot path goes through :func:`host_sync` -- the one choke

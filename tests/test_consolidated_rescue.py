@@ -1,0 +1,145 @@
+"""Consolidated rescue program == legacy per-strategy programs.
+
+The prewarm diet folds the r05 zoo's four per-bucket rescue variants
+(seeded polish / seeded full-PTC / seeded LM / unseeded re-solve) into
+ONE strategy-parameterized program per bucket (`_rescue_program`):
+strategy is a static branch pair under ``lax.cond``, seededness a
+traced select, pacing traced scalars. These tests pin the contract
+that made the fold safe: for every variant, on clean lanes AND on a
+genuinely-failing corpus, the consolidated program's results are
+byte-for-byte those of the dedicated legacy program -- and the ladder
+verdicts a full sweep emits survive a fault-injected retry unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import batch
+from pycatkin_tpu.robustness import chunked_sweep_steady_state
+from pycatkin_tpu.robustness.faults import FaultPlan, FaultSpec, fault_scope
+from pycatkin_tpu.robustness.ladder import DegradationPolicy
+from pycatkin_tpu.solvers.newton import SolverOptions
+
+_FAST = DegradationPolicy(base_delay_s=0.001, max_delay_s=0.002)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 48
+    conds = batch.broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(400.0, 800.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def _legacy(spec, opts, strategy, conds, keys, x0):
+    return batch._steady_program(spec, opts, strategy=strategy)(
+        conds, keys, x0)
+
+
+def _consolidated(spec, opts, strategy, use_x0, conds, keys, x0,
+                  x_dtype, n_dyn):
+    prog = batch._rescue_program(spec, batch._pacing_key(opts))
+    scal = (np.int32(1 if strategy == "lm" else 0), np.bool_(use_x0),
+            np.float64(opts.dt0), np.float64(opts.dt_grow_min),
+            np.int64(opts.max_steps), np.int64(opts.max_attempts))
+    n = np.asarray(conds.T).shape[0]
+    xc = (x0 if x0 is not None
+          else jnp.zeros((n, n_dyn), dtype=x_dtype))
+    return prog(*((conds, keys, xc) + scal))
+
+
+def _ladder_variants(opts):
+    """(name, rung opts, strategy, seeded) for every rung the sweep's
+    rescue ladder can dispatch through the consolidated program."""
+    return [
+        ("polish", batch._polish_opts(opts), "ptc", True),
+        ("full-ptc", opts, "ptc", True),
+        ("lm", opts, "lm", True),
+        ("unseeded", opts, "ptc", False),
+    ]
+
+
+def _assert_results_identical(name, a, b):
+    for f in a._fields:
+        va, vb = getattr(a, f), getattr(b, f)
+        if va is None and vb is None:
+            continue
+        na, nb = np.asarray(va), np.asarray(vb)
+        assert na.dtype == nb.dtype, (name, f)
+        assert na.tobytes() == nb.tobytes(), (
+            f"{name}: field {f!r} differs between legacy and "
+            f"consolidated rescue programs")
+
+
+def test_consolidated_matches_legacy_variants(problem):
+    spec, conds, _ = problem
+    opts = SolverOptions()
+    n = np.asarray(conds.T).shape[0]
+    dyn = jnp.asarray(spec.dynamic_indices)
+    keys = jax.random.split(jax.random.PRNGKey(3), n)
+    fast = batch._steady_program(spec, batch._fast_pass_opts(opts))(
+        conds, keys, None)
+    x0 = jnp.asarray(fast.x)[:, dyn]
+    for name, o, strat, seeded in _ladder_variants(opts):
+        x0arg = x0 if seeded else None
+        a = _legacy(spec, o, strat, conds, keys, x0arg)
+        b = _consolidated(spec, o, strat, seeded, conds, keys, x0arg,
+                          fast.x.dtype, int(dyn.size))
+        _assert_results_identical(name, a, b)
+
+
+def test_consolidated_matches_legacy_on_failure_corpus(problem):
+    # Seeded failure corpus: crippled pacing makes the fast pass fail
+    # real lanes; every ladder rung must then agree bitwise between
+    # the legacy per-strategy program and the consolidated one ON THE
+    # FAILED SUBSET -- the lanes whose verdicts the rescue actually
+    # decides.
+    spec, conds, _ = problem
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    n = np.asarray(conds.T).shape[0]
+    dyn = jnp.asarray(spec.dynamic_indices)
+    keys = jax.random.split(jax.random.PRNGKey(3), n)
+    fast = batch._steady_program(spec, batch._fast_pass_opts(opts))(
+        conds, keys, None)
+    failed = np.flatnonzero(~np.asarray(fast.success))
+    assert failed.size > 0, "corpus produced no failed lanes"
+    sub = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.asarray(x)[failed]), conds)
+    keys_f = keys[jnp.asarray(failed)]
+    x0_f = jnp.asarray(fast.x)[jnp.asarray(failed)][:, dyn]
+    for name, o, strat, seeded in _ladder_variants(opts):
+        x0arg = x0_f if seeded else None
+        a = _legacy(spec, o, strat, sub, keys_f, x0arg)
+        b = _consolidated(spec, o, strat, seeded, sub, keys_f, x0arg,
+                          fast.x.dtype, int(dyn.size))
+        _assert_results_identical(name, a, b)
+
+
+@pytest.mark.faults
+def test_ladder_verdicts_survive_injected_transient(problem):
+    # The chunked runner's fault sites drive the degradation ladder
+    # around the consolidated rescue: a transient at chunk:0 forces a
+    # full retry of that chunk, and the journaless sweep result must
+    # be byte-identical to an un-faulted run -- the retried dispatch
+    # rebuilds its donated buffers rather than reusing consumed ones.
+    spec, conds, mask = problem
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    kw = dict(chunk=16, tof_mask=mask, opts=opts, policy=_FAST)
+    clean_out, clean_rep = chunked_sweep_steady_state(spec, conds, **kw)
+    plan = FaultPlan([FaultSpec(site="chunk:0", kind="transient")])
+    with fault_scope(plan):
+        fault_out, fault_rep = chunked_sweep_steady_state(
+            spec, conds, **kw)
+    assert plan.log, "injected fault never fired"
+    assert fault_rep["n_failed_lanes"] == clean_rep["n_failed_lanes"]
+    assert set(clean_out) == set(fault_out)
+    for k in clean_out:
+        assert (np.asarray(clean_out[k]).tobytes()
+                == np.asarray(fault_out[k]).tobytes()), k

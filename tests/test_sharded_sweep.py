@@ -1,0 +1,142 @@
+"""Sharded-sweep equality: mesh vs no-mesh must be bit-identical.
+
+The tentpole guarantee of the mesh-aware sweep tail: passing
+``mesh=...`` to ``sweep_steady_state`` shards the lane axis end to end
+(fast pass, rescue subsets, stability screen, tier-2 Jacobian,
+TOF/activity) but changes NOTHING about the numbers -- every output
+array is byte-for-byte identical to the unsharded sweep on the same
+inputs.
+
+The equality runs on a 2-device mesh, the CI sharded lane's
+configuration (``--xla_force_host_platform_device_count=2``). The
+CONTRACT is same-inputs/same-programs determinism at that shard shape;
+XLA:CPU makes no bitwise promise across arbitrary per-shard shapes
+(measured: an 8-way shard of 48 lanes perturbs a residual by 1 ulp,
+which flips a convergence-threshold comparison on a handful of lanes
+-- a codegen reassociation artifact, not a sharding bug, and exactly
+why the sweep re-places every gathered subset deterministically
+instead of hoping).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import batch
+from pycatkin_tpu.solvers.newton import SolverOptions
+from pycatkin_tpu.utils import profiling
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="needs >=2 devices (XLA_FLAGS=--xla_force_host_platform_"
+           "device_count=N)")
+
+
+def _mesh2():
+    """The CI sharded lane's mesh: 2 devices over the lane axis."""
+    return batch.make_mesh(2)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 48
+    conds = batch.broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(400.0, 800.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def _run(problem, mesh=None, **kw):
+    spec, conds, mask = problem
+    # Fresh program caches per run: the equality must hold through a
+    # real compile of each side's programs, not through accidental
+    # registry sharing.
+    batch.clear_program_caches()
+    return batch.sweep_steady_state(spec, conds, tof_mask=mask,
+                                    mesh=mesh, **kw)
+
+
+def _assert_bit_identical(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        assert va.dtype == vb.dtype, k
+        assert va.shape == vb.shape, k
+        assert va.tobytes() == vb.tobytes(), (
+            f"key {k!r} differs between unsharded and sharded sweep")
+
+
+@needs_mesh
+def test_clean_sweep_bit_identical(problem):
+    _assert_bit_identical(_run(problem),
+                          _run(problem, mesh=_mesh2()))
+
+
+@needs_mesh
+def test_stability_sweep_bit_identical(problem):
+    _assert_bit_identical(
+        _run(problem, check_stability=True),
+        _run(problem, mesh=_mesh2(), check_stability=True))
+
+
+@needs_mesh
+def test_rescue_path_bit_identical(problem):
+    # Crippled pacing so the fast pass genuinely fails lanes and the
+    # consolidated rescue ladder runs on BOTH sides.
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    profiling.drain_events()
+    a = _run(problem, opts=opts)
+    n_rescues_a = len(profiling.peek_events("rescue"))
+    b = _run(problem, mesh=_mesh2(), opts=opts)
+    n_rescues_b = len(profiling.peek_events("rescue")) - n_rescues_a
+    assert n_rescues_a > 0, "corpus did not exercise the rescue ladder"
+    assert n_rescues_b == n_rescues_a
+    _assert_bit_identical(a, b)
+
+
+@needs_mesh
+def test_stability_demote_path_bit_identical(problem):
+    # An impossible Jacobian tolerance demotes every screened lane,
+    # driving the tier-2 + demote re-solve tail on both sides.
+    kw = dict(check_stability=True, pos_jac_tol=-1e6)
+    _assert_bit_identical(_run(problem, **kw),
+                          _run(problem, mesh=_mesh2(), **kw))
+
+
+def test_trivial_mesh_reuses_unsharded_program_keys(problem):
+    # A 1-device mesh must fingerprint exactly like no mesh at all --
+    # bench.py can pass make_mesh() unconditionally and still hit the
+    # stock single-device executables (registry AND AOT cache).
+    from pycatkin_tpu.parallel import compile_pool
+    spec, conds, mask = problem
+    mesh1 = batch.make_mesh(1)
+    sh = jax.sharding.NamedSharding(
+        mesh1, jax.sharding.PartitionSpec(mesh1.axis_names[0]))
+    plain = np.asarray(conds.T)
+    placed = jax.device_put(plain, sh)
+    opts = SolverOptions()
+    assert (batch._steady_kind(opts, "ptc", sh)
+            == batch._steady_kind(opts, "ptc"))
+    assert (compile_pool.program_key("k", (placed,))
+            == compile_pool.program_key("k", (plain,)))
+    assert compile_pool.args_sharding_fingerprint((placed,)) == ""
+
+
+@needs_mesh
+def test_sharded_program_keys_do_not_collide(problem):
+    # A genuinely sharded argument must key differently from the same
+    # array unsharded, so mesh and single-device executables can never
+    # serve each other from the registry or the AOT cache.
+    from pycatkin_tpu.parallel import compile_pool
+    mesh = batch.make_mesh()
+    sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+    plain = np.zeros(48)
+    placed = jax.device_put(plain, sh)
+    assert (compile_pool.program_key("k", (placed,))
+            != compile_pool.program_key("k", (plain,)))
+    assert compile_pool.args_sharding_fingerprint((placed,)) != ""
