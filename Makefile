@@ -8,14 +8,17 @@
 # dtype policy (PCL005) and the env-var registry (PCL006);
 # `lint-syncs`/`lint-faults` remain as single-rule aliases.
 # `bench-smoke` is the end-to-end canary: pclint plus an 8x8 CPU sweep
-# with prewarm that fails on any crash, any new lint finding, or a
-# clean sweep exceeding the host-sync budget.
+# with prewarm that fails on any crash, any new lint finding, a prewarm
+# layout over the program budget (<= 10), or a clean sweep spending
+# more than 2 counted host syncs. `aot-pack-selftest` round-trips the
+# shippable AOT cache pack (prewarm -> export -> import ->
+# prewarm-from-pack with zero compiles -> bit-identical sweep).
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-all lint \
-	lint-faults lint-syncs lint-baseline bench-smoke
+	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -54,3 +57,6 @@ lint-baseline:
 
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke
+
+aot-pack-selftest:
+	env JAX_PLATFORMS=cpu python tools/aot_pack.py selftest

@@ -18,9 +18,12 @@ hot-path files/functions HERE, nowhere else.
 from __future__ import annotations
 
 # A clean (zero-failure) sweep_steady_state may spend at most this many
-# counted blocking device->host materializations (the ISSUE-3 budget;
-# the implementation spends 2: solve fence + packed tail bundle).
-MAX_CLEAN_SYNCS = 3
+# counted blocking device->host materializations (tightened from the
+# ISSUE-3 budget of 3 by the fused one-dispatch tail, which spends 1:
+# the packed diagnostics bundle. The legacy split tail
+# (PYCATKIN_FUSED_SWEEP=0, fault plans) spends 2: solve fence + packed
+# tail bundle -- still within budget).
+MAX_CLEAN_SYNCS = 2
 
 # Inline annotation marking a reviewed failure-path transfer. Honored on
 # ANY line of a multi-line call (the pre-pclint lint only matched the
@@ -31,6 +34,7 @@ SYNC_ANNOTATION = "# sync-ok:"
 # plus the failure-path functions whose syncs must stay labeled.
 HOT_FUNCTIONS = frozenset({
     "batch_steady_state", "sweep_steady_state", "_finish_sweep",
+    "_fused_sweep", "_assemble_clean", "_stability_tier2",
     "_rescue", "_quarantine_mask", "stability_mask",
     "continuation_sweep",
 })

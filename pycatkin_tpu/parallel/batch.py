@@ -35,10 +35,13 @@ from . import compile_pool
 
 # Program-zoo budget: the number of distinct programs a full production
 # prewarm (bench.py bucket layout) may touch. The r05 zoo held 32
-# (4 strategy-specific rescue programs per solve bucket); consolidating
-# them into ONE strategy-parameterized rescue program per bucket shape
-# brings the full layout to 14. bench.py --smoke asserts the ceiling.
-PREWARM_PROGRAM_BUDGET = 14
+# (4 strategy-specific rescue programs per solve bucket); r06's
+# consolidated rescue program brought it to 14, and the fused sweep
+# program (solve + quarantine + tier-0 screen + TOF/activity + packed
+# diagnostics in ONE dispatch, :func:`_fused_sweep_program`) subsumes
+# the standalone fast-pass/screen/TOF programs, bringing the full
+# layout under 10. bench.py --smoke asserts the ceiling.
+PREWARM_PROGRAM_BUDGET = 10
 
 # Floor (pow2) for the stability tier-2 Jacobian subset shape: ambiguous
 # counts drift trial to trial, and every distinct pow2 shape below the
@@ -65,6 +68,7 @@ def clear_program_caches():
     including the engine-level transient chunk/finish programs and the
     AOT executable registry (compile_pool)."""
     _steady_program.cache_clear()
+    _fused_sweep_program.cache_clear()
     _rescue_program.cache_clear()
     _transient_chunk_program.cache_clear()
     _transient_finish_program.cache_clear()
@@ -126,6 +130,39 @@ def _rescue_kind(opts: SolverOptions, sharding=None) -> str:
 
 def _screen_kind(pos_tol: float, backend: str) -> str:
     return f"screen:{pos_tol!r}:{backend}"
+
+
+def _fused_kind(opts: SolverOptions, pos_tol: float, backend: str,
+                has_tof: bool, check_stability: bool,
+                sharding=None) -> str:
+    """Registry/cache kind string for the fused sweep program (solve +
+    quarantine + tier-0 certificate + TOF/activity + packed diagnostics
+    in ONE dispatch). prewarm, warm_from_aot_cache and the hot path
+    MUST derive it identically; ``opts`` must be the fast-pass options
+    (:func:`_fast_pass_opts`)."""
+    return (f"fused:{opts!r}:{pos_tol!r}:{backend}"
+            f":s{int(check_stability)}t{int(has_tof)}"
+            f"{_sharding_tag(sharding)}")
+
+
+def _fused_enabled() -> bool:
+    """Whether sweep_steady_state may take the fused one-dispatch tail.
+
+    ON by default; OFF when (a) the caller disabled it
+    (``PYCATKIN_FUSED_SWEEP=0``) or (b) a fault-injection plan is
+    active: ``nan``-kind fault transforms poison the OUTPUT of a
+    retried dispatch, and the fused program computes its quarantine
+    verdicts INSIDE the dispatch -- poison applied after the fact would
+    bypass them, silently voiding the per-lane containment the fault
+    tests certify. The legacy split pipeline (solve dispatch, then
+    tail programs) keeps every fault site meaningful, exactly like
+    robustness/chunked.py dropping double-buffering under an active
+    plan."""
+    from ..robustness.faults import active_plan
+    if active_plan() is not None:
+        return False
+    return os.environ.get("PYCATKIN_FUSED_SWEEP", "1").strip().lower() \
+        not in ("0", "off", "none", "disabled", "false")
 
 
 def _registered_call(spec: ModelSpec, kind: str, prog, args):
@@ -503,6 +540,122 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float,
     return jax.jit(batched)
 
 
+@lru_cache(maxsize=16)
+def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
+                         pos_tol: float, backend: str, has_tof: bool,
+                         check_stability: bool, out_sharding=None):
+    """The whole clean sweep as ONE device program: batched steady
+    solve, per-lane NaN quarantine, tier-0 stability certificate
+    (Gershgorin + deflated-Lyapunov -- byte-identical math to
+    :func:`_stability_screen_program`), TOF/activity, and the packed
+    int32 diagnostics bundle. A clean 65,536-lane stability-screened
+    volcano sweep is one dispatch + one host sync (the bundle);
+    anything ambiguous escalates OUTSIDE this program
+    (:func:`_fused_sweep`).
+
+    Output tuple, in order: ``res`` (SteadyStateResults, success
+    already quarantine-demoted), ``quar`` [lanes]; with
+    ``check_stability``: ``cert`` [lanes] (certified stable),
+    ``amb`` [lanes] (converged+finite but uncertified); with
+    ``has_tof``: ``tofs`` [lanes], ``act`` [lanes], ``neg`` [lanes]
+    (finite-and-negative TOF -- per-lane so the escalation path can
+    recount negatives host-side without a second TOF dispatch); always
+    last: the packed diagnostics bundle
+    (:func:`solvers.newton.packed_sweep_diagnostics`).
+
+    ``opts`` must be the fast-pass options and ``backend`` the
+    resolved executing platform (see :func:`_stability_screen_program`
+    on why backend is a cache key). Only the PRNG keys are donated
+    (conds/x0 are caller-owned)."""
+    from ..solvers.newton import (LYAPUNOV_MAX_DIM,
+                                  deflation_basis_for_spec,
+                                  effective_unit_roundoff,
+                                  lane_finite_mask,
+                                  lyapunov_certified_stable,
+                                  packed_sweep_diagnostics,
+                                  stability_tolerance_from_scale)
+
+    dyn = jnp.asarray(spec.dynamic_indices)
+
+    def solve_one(cond, key, x0):
+        return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
+                                   strategy="ptc")
+
+    if check_stability:
+        eps_eff = effective_unit_roundoff(jnp.float64, backend)
+        Q = deflation_basis_for_spec(spec)       # static per spec
+        use_lyap = 0 < Q.shape[1] <= LYAPUNOV_MAX_DIM
+
+        def screen_one(cond, y):
+            # EXACTLY _stability_screen_program's tier-0 body: the
+            # equivalence corpus (tests/test_tiered_screen.py) pins
+            # the fused verdicts bitwise against the standalone
+            # screen's, so any drift here is a test failure.
+            J = engine.steady_jacobian(spec, cond, y[dyn])
+            absJ = jnp.abs(J)
+            diag = jnp.diag(J)
+            offrow = jnp.sum(absJ, axis=1) - jnp.abs(diag)
+            offcol = jnp.sum(absJ, axis=0) - jnp.abs(diag)
+            bound = jnp.minimum(jnp.max(diag + offrow),
+                                jnp.max(diag + offcol))
+            scale = jnp.max(absJ)
+            finite = jnp.all(jnp.isfinite(J))
+            tol = stability_tolerance_from_scale(scale, pos_tol)
+            cert = finite & (bound <= tol)
+            if use_lyap:
+                cert = cert | (finite & lyapunov_certified_stable(
+                    J, Q, tol, eps_eff=eps_eff))
+            return cert, finite
+
+    def program(conds, keys, x0, *tail_args):
+        res = jax.vmap(solve_one)(conds, keys, x0)
+        # Quarantine demotion IN-PROGRAM (same math as
+        # _quarantine_mask): flagged-converged lanes whose stored
+        # solution/residual is non-finite are poisoned results.
+        finite_l = lane_finite_mask(res.x, res.residual)
+        succ_raw = jnp.asarray(res.success)
+        quar = succ_raw & ~finite_l
+        succ0 = succ_raw & finite_l
+        res = res._replace(success=succ0)
+        outs = [res, quar]
+        amb = demoted = None
+        ok_spec = succ0
+        if check_stability:
+            cert_raw, finite = jax.vmap(screen_one)(conds, res.x)
+            good = finite & succ0
+            cert = good & cert_raw
+            amb = good & ~cert
+            demoted = succ0 & ~cert
+            ok_spec = succ0 & cert
+            outs += [cert, amb]
+        n_neg = None
+        if has_tof:
+            mask = tail_args[0]
+            tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(
+                conds, res.x)
+            act = engine.activity_from_tof(
+                tofs, jax.tree_util.tree_leaves(conds.T)[0])
+            neg = jnp.isfinite(tofs) & (tofs < 0.0)
+            lane_ok = ok_spec & jnp.isfinite(tofs)
+            n_neg = jnp.sum(lane_ok & (tofs < 0.0))
+            outs += [tofs, act, neg]
+        outs.append(packed_sweep_diagnostics(succ0, quar, amb, demoted,
+                                             n_neg))
+        return tuple(outs)
+
+    kw = {"donate_argnums": _donate_argnums((1,))}
+    if out_sharding is not None:
+        # out_shardings is a pytree PREFIX over the output tuple: one
+        # sharding per top-level element (the SteadyStateResults
+        # subtree takes the lane sharding wholesale; the scalar bundle
+        # is replicated).
+        n_lane_outs = 2 + (2 if check_stability else 0) \
+            + (3 if has_tof else 0)
+        repl = NamedSharding(out_sharding.mesh, P())
+        kw["out_shardings"] = (out_sharding,) * n_lane_outs + (repl,)
+    return jax.jit(program, **kw)
+
+
 def _padded_subset(conds: Conditions, idx: np.ndarray, arrays=(),
                    bucket: int = 64):
     """Gather lanes ``idx`` of a Conditions pytree (plus companion
@@ -584,7 +737,6 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     of compiling an unsharded twin in-band. Returns a DEVICE bool
     array.
     """
-    from ..solvers.newton import stability_tolerance
     ys = jnp.asarray(ys)
     n = ys.shape[0]
     ok_dev = (jnp.asarray(ok).astype(bool) if ok is not None
@@ -612,32 +764,51 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
                 run_screen, label="stability screen")
     if n_amb:
         idx = np.flatnonzero(np.asarray(ambiguous))  # sync-ok: tier-2 failure path
-        # Ambiguous counts drift trial to trial; the TIER2_MIN_BUCKET
-        # floor collapses every sub-512 count onto ONE compiled shape
-        # (pads are sliced off on device before the host transfer).
-        sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,),
-                                          bucket=TIER2_MIN_BUCKET)
-        sub, ys_p = _place_subset(mesh, len(idx_p), sub, ys_p)
-
-        # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
-        # never cross the ~11 MB/s tunnel (pow2 padding can nearly
-        # double the payload).
-        def run_jac():
-            return host_sync(
-                _registered_call(spec, "jac", _jacobian_program(spec),
-                                 (sub, ys_p))[:len(idx)],
-                "tier-2 jacobian")
-
-        with span("tier-2 jacobian"):
-            Js = call_with_backend_retry(
-                run_jac, label="stability tier-2 jacobian")
-        eig = np.linalg.eigvals(Js)
-        tol_sub = stability_tolerance(Js, pos_tol)
-        host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
-        out = np.array(certified)    # writable host copy
-        out[idx] = host_ok
+        out = _stability_tier2(spec, conds, ys, idx,
+                               np.array(certified),  # sync-ok: tier-2 failure path, writable host copy
+                               pos_tol, mesh=mesh)
         return jnp.asarray(out)
     return certified
+
+
+def _stability_tier2(spec: ModelSpec, conds: Conditions, ys,
+                     idx: np.ndarray, certified_host: np.ndarray,
+                     pos_tol: float,
+                     mesh: Optional[Mesh] = None) -> np.ndarray:
+    """Tier-2 host eigensolve over the ambiguous lanes ``idx``: batch
+    the subset Jacobians on device (padded to the TIER2_MIN_BUCKET
+    floor so drifting ambiguous counts share ONE compiled shape),
+    ``numpy.linalg.eigvals`` on the host, and merge the verdicts into
+    the writable ``certified_host`` copy. Shared by
+    :func:`stability_mask` (the legacy two-tier path) and the fused
+    sweep's escalation branch (:func:`_fused_sweep`) so their verdicts
+    cannot drift. Returns the merged host bool array [lanes]."""
+    from ..solvers.newton import stability_tolerance
+    ys = jnp.asarray(ys)
+    # Ambiguous counts drift trial to trial; the TIER2_MIN_BUCKET
+    # floor collapses every sub-512 count onto ONE compiled shape
+    # (pads are sliced off on device before the host transfer).
+    sub, idx_p, ys_p = _padded_subset(conds, idx, (ys,),
+                                      bucket=TIER2_MIN_BUCKET)
+    sub, ys_p = _place_subset(mesh, len(idx_p), sub, ys_p)
+
+    # Slice the pad off ON DEVICE: the padded lanes' Jacobians must
+    # never cross the ~11 MB/s tunnel (pow2 padding can nearly
+    # double the payload).
+    def run_jac():
+        return host_sync(
+            _registered_call(spec, "jac", _jacobian_program(spec),
+                             (sub, ys_p))[:len(idx)],
+            "tier-2 jacobian")
+
+    with span("tier-2 jacobian"):
+        Js = call_with_backend_retry(
+            run_jac, label="stability tier-2 jacobian")
+    eig = np.linalg.eigvals(Js)
+    tol_sub = stability_tolerance(Js, pos_tol)
+    host_ok = np.all(eig.real <= tol_sub[..., None], axis=-1)
+    certified_host[idx] = host_ok
+    return certified_host
 
 
 def _neighbor_seed_lanes(conds: Conditions, success: np.ndarray):
@@ -882,12 +1053,151 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
         if n % mesh.devices.size == 0:
             conds = shard_conditions(conds, mesh)
             tail_mesh = mesh
+    if _fused_enabled() and (mesh is None or tail_mesh is not None):
+        # The common case: ONE fused dispatch covers solve +
+        # quarantine + tier-0 certificate + TOF + diagnostics, and a
+        # clean sweep exits on one counted host sync. Failures and
+        # uncertified lanes escalate from inside _fused_sweep; a lane
+        # count the mesh cannot divide keeps the legacy padded path.
+        return _fused_sweep(spec, conds, tof_mask, x0, opts,
+                            check_stability, pos_jac_tol,
+                            mesh=tail_mesh)
     res = batch_steady_state(spec, conds, x0=x0, opts=_fast_pass_opts(opts),
                              mesh=mesh)
     return _finish_sweep(spec, conds, res, opts, tof_mask,
                          check_stability, pos_jac_tol,
                          backend=_resolve_backend(mesh=mesh),
                          mesh=tail_mesh)
+
+
+def _assemble_clean(res, quar, stable, tofs, act,
+                    check_stability: bool, has_tof: bool, n_neg: int):
+    """Sweep result dict from already-computed device arrays -- no
+    materialization happens here (the caller already has every count it
+    needs). Mirrors _finish_sweep's clean-branch assembly exactly so
+    the fused path's output is field-for-field identical."""
+    out = {"y": res.x, "success": res.success,
+           "residual": res.residual, "iterations": res.iterations,
+           "attempts": res.attempts, "quarantined": quar}
+    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+        v = getattr(res, name, None)
+        if v is not None:
+            out[name] = v
+    if check_stability:
+        out["stable"] = stable
+        out["success"] = jnp.logical_and(jnp.asarray(res.success),
+                                         jnp.asarray(stable))
+    if has_tof:
+        out["tof"] = tofs
+        out["activity"] = act
+        _warn_negative_tof(n_neg)
+    return out
+
+
+def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
+                 opts: SolverOptions, check_stability: bool,
+                 pos_jac_tol: float, mesh: Optional[Mesh] = None):
+    """The fused-dispatch sweep: one device program
+    (:func:`_fused_sweep_program`) computes the solve, the quarantine
+    demotion, the tier-0 stability certificate, TOF/activity and the
+    packed diagnostics bundle; ONE counted host sync (the bundle)
+    decides the outcome tier:
+
+    - CLEAN (no failures; every converged lane certified): assemble
+      the result from the already-computed device arrays. 1 counted
+      sync total.
+    - TIER-2 ESCALATION (no failures, but some converged lanes only
+      AMBIGUOUS -- the one-sided certificates abstained): pull the
+      verdict masks in one batched sync, run the existing host
+      eigensolve on the ambiguous subset (:func:`_stability_tier2`,
+      gather-compacted to the TIER2_MIN_BUCKET floor), and -- when the
+      eigensolve confirms every lane -- finish with the fused TOF
+      arrays (they do not depend on the verdict masks). 3 counted
+      syncs, no extra full-shape dispatch.
+    - Anything else (failed/quarantined lanes, host-eig demotions):
+      reconstruct the raw fast-pass result and hand it to the exact
+      legacy tail (:func:`_finish_sweep` -- rescue ladder, demote
+      loop, final TOF), bit-for-bit.
+    """
+    n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    backend = _resolve_backend(mesh=mesh)
+    fast = _fast_pass_opts(opts)
+    has_tof = tof_mask is not None
+    sh = _subset_sharding(mesh, n_lanes)
+    prog = _fused_sweep_program(spec, fast, pos_jac_tol, backend,
+                                has_tof, check_stability, sh)
+    kind = _fused_kind(fast, pos_jac_tol, backend, has_tof,
+                       check_stability, sh)
+    mask_arr = jnp.asarray(tof_mask) if has_tof else None
+    tail = (mask_arr,) if has_tof else ()
+
+    def run_fused():
+        # Keys are rebuilt per retry (the program donates them); the
+        # ONE materialization (the packed bundle) rides inside the
+        # retried unit so an execution-time transport flake re-runs
+        # the whole (pure) program.
+        keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
+        if sh is not None:
+            keys = jax.device_put(keys, sh)
+        out = _registered_call(spec, kind, prog,
+                               (conds, keys, x0) + tail)
+        return out[:-1] + (host_sync(out[-1], "fused tail bundle"),)
+
+    with span("fused sweep"):
+        out = call_with_backend_retry(run_fused,
+                                      label="batched steady solve")
+    res, quar = out[0], out[1]
+    pos = 2
+    cert = amb = None
+    if check_stability:
+        cert, amb = out[pos], out[pos + 1]
+        pos += 2
+    tofs = act = neg = None
+    if has_tof:
+        tofs, act, neg = out[pos], out[pos + 1], out[pos + 2]
+        pos += 3
+    nf, nq, n_amb, n_dem, n_neg = (int(c) for c in out[pos])
+
+    if nf == 0 and (not check_stability
+                    or (n_amb == 0 and n_dem == 0)):
+        # Clean sweep: everything already computed; no further syncs.
+        return _assemble_clean(res, quar, cert, tofs, act,
+                               check_stability, has_tof, n_neg)
+
+    if nf == 0 and check_stability and n_amb > 0 and n_dem == n_amb:
+        # Tier-2-only escalation: every demoted lane is merely
+        # AMBIGUOUS (certificates abstained; nothing failed, nothing
+        # screen-non-finite). One batched mask pull, then the host
+        # eigensolve over the compacted subset.
+        pull = (amb, cert) + ((neg,) if has_tof else ())
+        got = host_sync(pull, "tier-0 escalation masks")
+        idx = np.flatnonzero(got[0])
+        stable_h = _stability_tier2(spec, conds, res.x, idx,
+                                    np.array(got[1]), pos_jac_tol,
+                                    mesh=mesh)
+        if stable_h[idx].all():
+            # Host eig confirmed every escalated lane: verdicts are
+            # final and nothing is demoted, so the fused TOF/activity
+            # arrays stand as-is (they never depended on the verdict
+            # masks -- only the n_neg aggregate did, recounted here
+            # from the per-lane negatives with every lane now ok).
+            n_neg2 = int(np.sum(got[2])) if has_tof else 0
+            return _assemble_clean(res, quar, jnp.asarray(stable_h),
+                                   tofs, act, check_stability, has_tof,
+                                   n_neg2)
+        # Host eig DEMOTED lanes: they need the unseeded re-solve +
+        # re-judge loop -- exact legacy territory (below).
+
+    # Failure path: reconstruct the raw (pre-quarantine) fast-pass
+    # result and run the exact legacy tail. _finish_sweep re-derives
+    # quarantine/screen/TOF itself, so the fused outputs are dropped
+    # wholesale -- the speculative dispatch is the acceptable waste on
+    # this rare path, bit-identity is not negotiable.
+    res_raw = res._replace(success=jnp.asarray(res.success)
+                           | jnp.asarray(quar))
+    return _finish_sweep(spec, conds, res_raw, opts, tof_mask,
+                         check_stability, pos_jac_tol, backend=backend,
+                         mesh=mesh)
 
 
 def _quarantine_mask(res, quarantined=None):
@@ -1238,19 +1548,20 @@ def prewarm_program_count(buckets=(64, 128, 256), aot_buckets=(),
                           tof: bool = True,
                           check_stability: bool = True) -> int:
     """Programs a :func:`prewarm_sweep_programs` call with this layout
-    ensures, WITHOUT compiling anything: fast pass + screen (when
-    stability is on) + TOF (when a mask is given) + ONE consolidated
-    rescue program per solve bucket + one subset-Jacobian program per
-    tier-2 bucket. ``bench.py --smoke`` holds the production layout to
-    ``PREWARM_PROGRAM_BUDGET`` through this arithmetic (the full bench
-    is too expensive for the CI lane to actually prewarm)."""
-    n = 1                                     # full-shape fast pass
-    if check_stability:
-        n += 1                                # stability screen
-    if tof:
-        n += 1                                # TOF/activity
+    ensures, WITHOUT compiling anything: ONE fused full-shape sweep
+    program (solve + quarantine + tier-0 screen + TOF + diagnostics --
+    the ``tof``/``check_stability`` flags select the program VARIANT,
+    they no longer add programs) + ONE consolidated rescue program per
+    solve bucket + one subset-Jacobian program per tier-2 bucket (only
+    reachable with stability on). ``bench.py --smoke`` holds the
+    production layout to ``PREWARM_PROGRAM_BUDGET`` through this
+    arithmetic (the full bench is too expensive for the CI lane to
+    actually prewarm)."""
+    del tof                                   # variant flag, not a program
+    n = 1                                     # fused full-shape sweep
     n += len(set(buckets) | set(aot_buckets))          # rescue
-    n += len(set(tier2_buckets) | set(tier2_aot_buckets))  # tier-2 jac
+    if check_stability:
+        n += len(set(tier2_buckets) | set(tier2_aot_buckets))  # tier-2 jac
     return n
 
 
@@ -1277,12 +1588,24 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     first time lanes actually fail -- which lands tens of seconds of
     remote compile (plus its transport flake risk, the round-4 bench
     crash) inside a timed trial or a production solve. One call here
-    front-loads: the full-shape fast pass, the screen, the TOF/activity
-    program, ONE consolidated rescue program per pow2 solve bucket
+    front-loads: the FUSED full-shape sweep program
+    (:func:`_fused_sweep_program` -- solve, quarantine, tier-0 screen,
+    TOF/activity and the diagnostics bundle in one executable; the r05
+    standalone fast-pass/screen/TOF programs are gone from the zoo),
+    ONE consolidated rescue program per pow2 solve bucket
     (strategy/seededness/pacing are runtime arguments of
     :func:`_rescue_program` -- the r05 zoo's four per-bucket variants
     collapsed into it), and the subset Jacobian at the ``tier2_*``
-    shapes only.
+    shapes only. The standalone screen/TOF programs still exist for
+    the legacy split tail (``PYCATKIN_FUSED_SWEEP=0``, fault plans,
+    continuation sweeps) but compile in-band there -- rare paths do
+    not get zoo slots.
+
+    ``check_stability`` (and ``tof_mask``-ness) is part of the fused
+    program's identity now -- the r05 layout shared one fast-pass
+    executable across both settings, the fused executable cannot --
+    so prewarm with the SAME value the sweeps will pass, as
+    ``bench.py`` and the dispatch workers do.
 
     Compile/fast-pass OVERLAP (vs the r05 sequential loop, 136.6 s for
     32 programs): the tail-program job list is built from ABSTRACT
@@ -1428,13 +1751,22 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         k = jax.random.split(jax.random.PRNGKey(0), n)
         return jax.device_put(k, sharding) if sharding is not None else k
 
-    # --- the fast pass program first (blocking: everything else's
-    # result shapes derive from it) ---
-    fast_kind = _steady_kind(_fast_pass_opts(opts), "ptc", sharding)
-    fast_prog = _steady_program(spec, _fast_pass_opts(opts), sharding)
+    # --- the fused sweep program first (blocking: everything else's
+    # result shapes derive from it). Solve + quarantine + tier-0
+    # screen + TOF/activity + the diagnostics bundle are ONE program;
+    # its kind/key must match what _fused_sweep dispatches exactly. ---
+    fast_opts = _fast_pass_opts(opts)
+    has_tof = tof_mask is not None
+    mask_arr = jnp.asarray(tof_mask) if has_tof else None
+    tail = (mask_arr,) if has_tof else ()
+    fast_kind = _fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
+                            check_stability, sharding)
+    fast_prog = _fused_sweep_program(spec, fast_opts, pos_jac_tol,
+                                     backend, has_tof, check_stability,
+                                     sharding)
     fast_job = {"kind": fast_kind, "prog": fast_prog,
-                "args": (conds, _keys_full(), None),
-                "label": f"fast pass @{n}"}
+                "args": (conds, _keys_full(), None) + tail,
+                "label": f"fused sweep @{n}"}
     _ensure([fast_job])
 
     # --- build the FULL job list from abstract result shapes: no
@@ -1442,9 +1774,9 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     # fast pass below. ys-dependent arguments enter the jobs as
     # jax.ShapeDtypeStruct (lower() and program_key() only consume
     # shape/dtype/sharding); phase C builds the concrete arrays. ---
-    shapes = jax.eval_shape(fast_prog, conds, _keys_full(), None)
-    x_dtype = shapes.x.dtype
-    n_species = shapes.x.shape[1]
+    shapes = jax.eval_shape(fast_prog, conds, _keys_full(), None, *tail)
+    x_dtype = shapes[0].x.dtype
+    n_species = shapes[0].x.shape[1]
 
     def _sds(shape, dtype, bsh=None):
         if bsh is None:
@@ -1467,26 +1799,8 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                      "exec_args": exec_args})
 
     solve_fence = lambda r: jnp.sum(r.residual)           # noqa: E731
-    scalar2_fence = lambda out: out[2]                    # noqa: E731
     jac_fence = lambda J: jnp.sum(                        # noqa: E731
         jnp.where(jnp.isfinite(J), J, 0.0))
-
-    x_abs = _sds((n, n_species), x_dtype, sharding)
-    ok_full = jnp.ones(n, dtype=bool)
-    if sharding is not None:
-        ok_full = jax.device_put(ok_full, sharding)
-    if check_stability:
-        _add(_screen_kind(pos_jac_tol, backend),
-             _stability_screen_program(spec, pos_jac_tol, backend),
-             (conds, x_abs, ok_full),
-             f"stability screen @{n}", True, scalar2_fence,
-             exec_args=lambda res: (conds, res.x, ok_full))
-    if tof_mask is not None:
-        mask_arr = jnp.asarray(tof_mask)
-        _add("tof", _tof_program(spec),
-             (conds, x_abs, mask_arr, ok_full),
-             f"tof/activity @{n}", True, scalar2_fence,
-             exec_args=lambda res: (conds, res.x, mask_arr, ok_full))
 
     def _bucket_conds(b):
         idx = np.arange(b) % n
@@ -1553,8 +1867,9 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             _add_jac(b, False)
 
     def run_fast():
-        r = _registered_call(spec, fast_kind, fast_prog,
-                             (conds, _keys_full(), None))
+        out = _registered_call(spec, fast_kind, fast_prog,
+                               (conds, _keys_full(), None) + tail)
+        r = out[0]
         np.asarray(jnp.sum(r.residual))      # sync inside the retry
         return r
 
@@ -1570,7 +1885,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         pending = compile_pool.submit_compile(
             [lambda j=job: _compile_and_publish(j)
              for job in to_compile], workers)
-        res = timed_retry(run_fast, f"fast pass @{n}")
+        res = timed_retry(run_fast, f"fused sweep @{n}")
         pending.wait()
         if to_compile:
             n_compiled += len(to_compile)
@@ -1579,7 +1894,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                  f"{_time.perf_counter() - t0:.2f} s")
     else:
         _ensure(jobs)
-        res = timed_retry(run_fast, f"fast pass @{n}")
+        res = timed_retry(run_fast, f"fused sweep @{n}")
     n_executed = 1
 
     # --- phase C: run the executed buckets once (device is serial),
@@ -1625,9 +1940,9 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
     workers, parallel/dispatch.py): executing programs just to warm
     runtime caches would double their solve cost, but deserializing
     executables some earlier process already compiled is nearly free.
-    Program keys are derived from abstract shapes
-    (``jax.ShapeDtypeStruct``), so no fast pass is needed to obtain
-    result arrays."""
+    The whole clean sweep is ONE fused program now
+    (:func:`_fused_sweep_program`), so one registry entry covers the
+    worker's entire happy path."""
     if cache is None:
         cache = compile_pool.AOTCache(
             fingerprint=compile_pool.spec_fingerprint(spec))
@@ -1636,21 +1951,14 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
     n = jax.tree_util.tree_leaves(conds)[0].shape[0]
     keys = jax.random.split(jax.random.PRNGKey(0), n)
     fast_opts = _fast_pass_opts(opts)
-    fast_prog = _steady_program(spec, fast_opts)
-    shapes = jax.eval_shape(fast_prog, conds, keys, None)
-    x_sds = shapes.x                       # abstract [n, n_species]
-    ok_sds = jax.ShapeDtypeStruct((n,), np.dtype(bool))
-    jobs = [(_steady_kind(fast_opts, "ptc"), fast_prog,
-             (conds, keys, None))]
-    if check_stability:
-        backend = _resolve_backend()
-        jobs.append((_screen_kind(pos_jac_tol, backend),
-                     _stability_screen_program(spec, pos_jac_tol,
-                                               backend),
-                     (conds, x_sds, ok_sds)))
-    if tof_mask is not None:
-        jobs.append(("tof", _tof_program(spec),
-                     (conds, x_sds, jnp.asarray(tof_mask), ok_sds)))
+    backend = _resolve_backend()
+    has_tof = tof_mask is not None
+    tail = (jnp.asarray(tof_mask),) if has_tof else ()
+    jobs = [(_fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
+                         check_stability),
+             _fused_sweep_program(spec, fast_opts, pos_jac_tol, backend,
+                                  has_tof, check_stability),
+             (conds, keys, None) + tail)]
     n_loaded = 0
     for kind, _prog, args in jobs:
         key = compile_pool.program_key(kind, args)

@@ -2,17 +2,25 @@
 
 Two independent costs dominate a fresh process's time-to-first-sweep:
 
-1. XLA *compilation* of every program shape the sweep can touch
-   (:func:`parallel.batch.prewarm_sweep_programs` warms ~30 programs;
-   measured 136.6 s when compiled strictly sequentially, BENCH_r05).
+1. XLA *compilation* of every program shape the sweep can touch.
+   :func:`parallel.batch.prewarm_sweep_programs` warms the program zoo
+   -- dieted from 32 programs / 136.6 s sequential (BENCH_r05) down to
+   ``parallel.batch.PREWARM_PROGRAM_BUDGET`` (<= 10) now that the fused
+   sweep program subsumes the standalone fast-pass/screen/TOF programs.
    Compiles are GIL-releasing C++ work, so a bounded thread pool
-   (:func:`map_compile`) overlaps them nearly perfectly.
+   (:func:`map_compile`) overlaps them nearly perfectly (and with the
+   fast pass itself, via :func:`submit_compile`).
 2. Re-compilation on every *restart*. ``jax.jit``'s in-memory caches
    die with the process and the persistent XLA cache is disabled on
    CPU (utils/cache.py). :class:`AOTCache` serializes compiled
    executables (``jax.experimental.serialize_executable``) under a
    directory next to ``.jax_cache``; a restarted process deserializes
-   the executable and skips trace+compile entirely.
+   the executable and skips trace+compile entirely. The cache is also
+   *shippable*: :func:`export_cache_pack` archives a warm cache
+   directory (entries + verified manifest) and
+   :func:`import_cache_pack` unpacks it on another machine/checkout of
+   the same toolchain, so a fleet pays the compile wall once
+   (``tools/aot_pack.py`` is the CLI; target prewarm-from-pack < 30 s).
 
 Loaded/compiled executables are published in a process-wide *registry*
 keyed on (spec, program kind, argument shapes); the sweep hot path
@@ -415,3 +423,156 @@ def map_compile(tasks, workers: int | None = None):
     if errors:
         raise errors[0][1]
     return results
+
+
+# ---------------------------------------------------------------------
+# Shippable AOT cache packs. A warm cache directory is just a bag of
+# content-keyed `<key>.aot` entries; the pack format is a tar.gz of
+# those entries plus a manifest.json recording, per key, the metadata
+# a consumer needs to decide validity WITHOUT unpickling payloads
+# (key version, spec fingerprint, jax version, backend, device kind,
+# sharding fingerprint, device count, size). tools/aot_pack.py is the
+# CLI; bench.py measures prewarm-from-pack with it.
+PACK_MANIFEST = "manifest.json"
+
+
+def _entry_meta(path: str) -> dict:
+    """Validity metadata of one on-disk cache entry (unpickles the
+    entry dict but never deserializes the executable payload)."""
+    with open(path, "rb") as fh:
+        entry = pickle.load(fh)
+    return {"fingerprint": entry.get("fingerprint"),
+            "jax": entry.get("jax"),
+            "backend": entry.get("backend"),
+            "device_kind": entry.get("device_kind"),
+            "sharding": entry.get("sharding", ""),
+            "devices": entry.get("devices"),
+            "size": os.path.getsize(path)}
+
+
+def export_cache_pack(pack_path: str, cache_root: str | None = None) -> dict:
+    """Archive a warm AOT cache directory into a shippable pack
+    (tar.gz: every ``<key>.aot`` entry + a manifest). Unreadable
+    entries are skipped (counted). Returns a stats dict
+    ``{path, entries, skipped, bytes}``. Raises FileNotFoundError when
+    the cache directory does not exist or holds no entries -- shipping
+    an empty pack is always a caller bug."""
+    import json
+    import tarfile
+
+    root = cache_root or AOTCache().root
+    if not root or not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"export_cache_pack: no AOT cache directory at {root!r} "
+            "(run a prewarm first, or pass cache_root)")
+    names = sorted(f for f in os.listdir(root) if f.endswith(".aot"))
+    manifest: dict = {"format": "pycatkin-aot-pack-v1",
+                      "key_version": _KEY_VERSION, "entries": {}}
+    skipped = 0
+    total = 0
+    for name in names:
+        path = os.path.join(root, name)
+        try:
+            meta = _entry_meta(path)
+        except Exception:
+            skipped += 1                 # torn/foreign file: not shipped
+            continue
+        manifest["entries"][name[:-len(".aot")]] = meta
+        total += meta["size"]
+    if not manifest["entries"]:
+        raise FileNotFoundError(
+            f"export_cache_pack: no readable .aot entries under {root!r}")
+    os.makedirs(os.path.dirname(os.path.abspath(pack_path)) or ".",
+                exist_ok=True)
+    tmp = f"{pack_path}.tmp.{os.getpid()}"
+    try:
+        with tarfile.open(tmp, "w:gz") as tar:
+            for key in manifest["entries"]:
+                tar.add(os.path.join(root, f"{key}.aot"),
+                        arcname=f"{key}.aot")
+            import io as _io
+            blob = json.dumps(manifest, indent=2).encode()
+            info = tarfile.TarInfo(PACK_MANIFEST)
+            info.size = len(blob)
+            tar.addfile(info, _io.BytesIO(blob))
+        os.replace(tmp, pack_path)       # atomic publish
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return {"path": pack_path, "entries": len(manifest["entries"]),
+            "skipped": skipped, "bytes": total}
+
+
+def import_cache_pack(pack_path: str, cache_root: str | None = None,
+                      verify: bool = True) -> dict:
+    """Unpack an exported AOT pack into a cache directory.
+
+    Extraction is defensive: only flat ``<key>.aot`` members named in
+    the manifest are written (no paths, no links -- a hostile archive
+    cannot traverse out of ``cache_root``), each via a temp file +
+    atomic rename so a killed import never publishes a torn entry.
+    With ``verify`` (default) every entry is unpickled and checked
+    against the manifest: key-format version, spec fingerprint and the
+    filename<->manifest agreement are hard errors (ValueError --
+    executing a mismatched entry would run the wrong program);
+    toolchain drift (jax version / backend / device kind / device
+    count vs THIS process) is counted under ``foreign_toolchain`` but
+    still imported -- AOTCache.load treats those as silent misses, and
+    the pack may legitimately serve several platforms. Existing
+    entries are overwritten. Returns
+    ``{root, imported, foreign_toolchain, bytes}``."""
+    import json
+    import tarfile
+
+    import jax
+
+    root = cache_root or AOTCache().root
+    if not root:
+        raise ValueError("import_cache_pack: the AOT cache is disabled "
+                         "(PYCATKIN_AOT_CACHE) and no cache_root given")
+    with tarfile.open(pack_path, "r:gz") as tar:
+        fh = tar.extractfile(PACK_MANIFEST)
+        if fh is None:
+            raise ValueError(
+                f"import_cache_pack: {pack_path} has no {PACK_MANIFEST}")
+        manifest = json.load(fh)
+        if manifest.get("key_version") != _KEY_VERSION:
+            raise ValueError(
+                "import_cache_pack: pack was written with key format "
+                f"{manifest.get('key_version')!r}, this build uses "
+                f"{_KEY_VERSION!r} -- its keys can never be looked up")
+        os.makedirs(root, exist_ok=True)
+        dev = jax.devices()[0]
+        imported = 0
+        foreign = 0
+        total = 0
+        for key, meta in manifest.get("entries", {}).items():
+            name = f"{key}.aot"
+            member = tar.getmember(name)   # KeyError: truncated pack
+            if not member.isfile() or "/" in key or "\\" in key \
+                    or key in (".", ".."):
+                raise ValueError(
+                    f"import_cache_pack: refusing member {name!r}")
+            blob = tar.extractfile(member).read()
+            if verify:
+                entry = pickle.loads(blob)
+                if entry.get("fingerprint") != meta.get("fingerprint"):
+                    raise ValueError(
+                        f"import_cache_pack: entry {key} fingerprint "
+                        "disagrees with the pack manifest (tampered or "
+                        "torn pack)")
+                if (entry.get("jax") != jax.__version__
+                        or entry.get("backend") != dev.platform
+                        or entry.get("device_kind") != dev.device_kind
+                        or (entry.get("sharding")
+                            and entry.get("devices")
+                            != jax.device_count())):
+                    foreign += 1
+            tmp = os.path.join(root, f"{name}.tmp.{os.getpid()}")
+            with open(tmp, "wb") as out:
+                out.write(blob)
+            os.replace(tmp, os.path.join(root, name))
+            imported += 1
+            total += len(blob)
+    return {"root": root, "imported": imported,
+            "foreign_toolchain": foreign, "bytes": total}

@@ -89,7 +89,8 @@ def span(label: str, **fields):
 # round trip regardless of payload, which makes "how many times did the
 # host block on the device" the primary latency metric of a sweep. The
 # budget is CONTRACTUAL: a clean (zero-failure) sweep_steady_state may
-# perform at most 3 counted syncs (tests/test_sync_budget.py), and
+# perform at most 2 counted syncs (tests/test_sync_budget.py; the fused
+# one-dispatch tail spends 1 -- the packed bundle), and
 # tools/lint_host_syncs.py statically flags raw np.asarray/int(jnp.
 # materializations in the hot-path functions that bypass this counter.
 _SYNC_LOCK = threading.Lock()
@@ -99,12 +100,19 @@ _SYNC_LABELS: list = []
 
 def host_sync(value, label: str = ""):
     """Materialize ``value`` onto the host (the blocking sync point) and
-    count it. Returns the numpy array. ``label`` tags the site for
-    debugging (see :func:`sync_labels`)."""
+    count it ONCE. ``value`` is usually a single array (returns the
+    numpy array, the historical contract); a tuple/list/dict of arrays
+    is transferred as ONE batched ``jax.device_get`` and returned with
+    every leaf as numpy -- a pytree of masks costs one counted round
+    trip, not one per leaf. ``label`` tags the site for debugging (see
+    :func:`sync_labels`)."""
     global _SYNC_COUNT
     with _SYNC_LOCK:
         _SYNC_COUNT += 1
         _SYNC_LABELS.append(label)
+    if isinstance(value, (tuple, list, dict)):
+        import jax
+        return jax.tree_util.tree_map(np.asarray, jax.device_get(value))
     return np.asarray(value)
 
 

@@ -1,0 +1,195 @@
+"""Shippable AOT cache packs (parallel/compile_pool + tools/aot_pack).
+
+The pack is how a fleet worker (or a post-wipe checkout) skips the
+compile wall: export archives a warm cache directory, import rebuilds
+one elsewhere, and the rebuilt entries must load as bit-identical
+executables. Verification is NOT optional courtesy: a tampered or torn
+pack must refuse to import (executing a mismatched entry would run the
+wrong program), foreign-toolchain entries are counted but kept
+(AOTCache.load treats them as silent misses), and hostile member names
+can never escape the cache root. The full prewarm -> export -> import
+-> sweep bit-identity promise is exercised end-to-end by
+``python tools/aot_pack.py selftest`` (the CI round-trip gate) and the
+cache-level equivalent in tests/test_compile_pool.py; these tests pin
+the pack FORMAT contracts cheaply with small hand-built entries.
+"""
+
+import json
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu.parallel import compile_pool
+
+
+def _make_cache(root, n_entries=2, fingerprint="fp0"):
+    """A real cache directory holding ``n_entries`` serialized
+    executables; returns (cache, {key: (args, expected_output)})."""
+    cache = compile_pool.AOTCache(root=str(root), fingerprint=fingerprint)
+    entries = {}
+    for i in range(n_entries):
+        @jax.jit
+        def f(x, _i=i):
+            return jnp.sin(x) * (_i + 1) + jnp.sum(x)
+
+        x = jnp.asarray(np.random.default_rng(i).normal(size=(6, 4)))
+        compiled = f.lower(x).compile()
+        key = compile_pool.program_key(f"pack-test:{i}", (x,))
+        assert cache.save(key, compiled)
+        entries[key] = (x, np.asarray(compiled(x)))
+    return cache, entries
+
+
+def test_pack_round_trip_loads_bit_identical(tmp_path):
+    root_a = tmp_path / "a"
+    root_b = tmp_path / "b"
+    pack = str(tmp_path / "cache.aotpack.tgz")
+    _, entries = _make_cache(root_a, n_entries=3)
+
+    exported = compile_pool.export_cache_pack(pack, cache_root=str(root_a))
+    assert exported["entries"] == 3 and exported["skipped"] == 0
+    assert os.path.exists(pack)
+
+    imported = compile_pool.import_cache_pack(pack, cache_root=str(root_b))
+    assert imported["imported"] == 3
+    assert imported["foreign_toolchain"] == 0
+
+    fresh = compile_pool.AOTCache(root=str(root_b), fingerprint="fp0")
+    for key, (x, want) in entries.items():
+        exe = fresh.load(key)
+        assert exe is not None, key
+        np.testing.assert_array_equal(np.asarray(exe(x)), want)
+    assert fresh.hits == 3
+
+
+def test_pack_cli_export_import(tmp_path, capsys):
+    """The tools/aot_pack.py CLI drives the same library entry points."""
+    from tools.aot_pack import main
+
+    root_a = tmp_path / "a"
+    root_b = tmp_path / "b"
+    pack = str(tmp_path / "cli.aotpack.tgz")
+    _make_cache(root_a, n_entries=2)
+
+    assert main(["export", pack, "--cache-root", str(root_a)]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["entries"] == 2
+
+    assert main(["import", pack, "--cache-root", str(root_b)]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["imported"] == 2
+    assert sorted(os.listdir(root_b)) == sorted(os.listdir(root_a))
+
+
+def test_export_refuses_missing_or_empty_cache(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        compile_pool.export_cache_pack(
+            str(tmp_path / "p.tgz"), cache_root=str(tmp_path / "absent"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        compile_pool.export_cache_pack(
+            str(tmp_path / "p.tgz"), cache_root=str(empty))
+
+
+def _repack_with_manifest(pack_in, pack_out, mutate):
+    """Copy a pack, passing its parsed manifest through ``mutate``."""
+    with tarfile.open(pack_in, "r:gz") as tar:
+        members = {m.name: tar.extractfile(m).read()
+                   for m in tar.getmembers() if m.isfile()}
+    manifest = json.loads(members.pop(compile_pool.PACK_MANIFEST))
+    mutate(manifest)
+    members[compile_pool.PACK_MANIFEST] = json.dumps(manifest).encode()
+    import io
+    with tarfile.open(pack_out, "w:gz") as tar:
+        for name, blob in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+
+
+def test_import_rejects_tampered_fingerprint(tmp_path):
+    root_a = tmp_path / "a"
+    pack = str(tmp_path / "ok.tgz")
+    bad = str(tmp_path / "tampered.tgz")
+    _make_cache(root_a, n_entries=1)
+    compile_pool.export_cache_pack(pack, cache_root=str(root_a))
+
+    def flip_fingerprint(manifest):
+        for meta in manifest["entries"].values():
+            meta["fingerprint"] = "not-the-recorded-mechanism"
+
+    _repack_with_manifest(pack, bad, flip_fingerprint)
+    with pytest.raises(ValueError, match="fingerprint"):
+        compile_pool.import_cache_pack(bad,
+                                       cache_root=str(tmp_path / "b"))
+    # --no-verify territory: without verification the bytes do land.
+    out = compile_pool.import_cache_pack(
+        bad, cache_root=str(tmp_path / "c"), verify=False)
+    assert out["imported"] == 1
+
+
+def test_import_rejects_wrong_key_version(tmp_path):
+    root_a = tmp_path / "a"
+    pack = str(tmp_path / "ok.tgz")
+    bad = str(tmp_path / "oldkeys.tgz")
+    _make_cache(root_a, n_entries=1)
+    compile_pool.export_cache_pack(pack, cache_root=str(root_a))
+
+    def age_keys(manifest):
+        manifest["key_version"] = "aot-key-v1"
+
+    _repack_with_manifest(pack, bad, age_keys)
+    with pytest.raises(ValueError, match="key format"):
+        compile_pool.import_cache_pack(bad,
+                                       cache_root=str(tmp_path / "b"))
+
+
+def test_import_refuses_traversal_member_names(tmp_path):
+    """A hostile manifest naming entries with path components must be
+    refused outright -- nothing may be written outside cache_root."""
+    evil = str(tmp_path / "evil.tgz")
+    blob = pickle.dumps({"fingerprint": "fp", "payload": b""})
+    manifest = {"format": "pycatkin-aot-pack-v1",
+                "key_version": compile_pool._KEY_VERSION,
+                "entries": {"../escape": {"fingerprint": "fp",
+                                          "size": len(blob)}}}
+    import io
+    with tarfile.open(evil, "w:gz") as tar:
+        for name, payload in (("../escape.aot", blob),
+                              (compile_pool.PACK_MANIFEST,
+                               json.dumps(manifest).encode())):
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    with pytest.raises((ValueError, KeyError)):
+        compile_pool.import_cache_pack(evil,
+                                       cache_root=str(tmp_path / "b"))
+    assert not (tmp_path / "escape.aot").exists()
+
+
+def test_import_counts_foreign_toolchain_but_keeps_entry(tmp_path):
+    """An entry serialized by another jax build imports (the pack may
+    serve several platforms) but is counted so operators can see it;
+    AOTCache.load later treats it as a silent miss."""
+    root_a = tmp_path / "a"
+    root_a.mkdir()
+    entry = {"fingerprint": "fp", "jax": "0.0.0-not-this-version",
+             "backend": "cpu", "device_kind": "cpu", "sharding": "",
+             "devices": 1, "payload": b"x" * 16,
+             "in_tree": None, "out_tree": None}
+    with open(root_a / "feedf00d.aot", "wb") as fh:
+        pickle.dump(entry, fh)
+    pack = str(tmp_path / "foreign.tgz")
+    compile_pool.export_cache_pack(pack, cache_root=str(root_a))
+    out = compile_pool.import_cache_pack(pack,
+                                         cache_root=str(tmp_path / "b"))
+    assert out["imported"] == 1
+    assert out["foreign_toolchain"] == 1
+    assert (tmp_path / "b" / "feedf00d.aot").exists()

@@ -1,13 +1,16 @@
 """Host-sync budget contract of the sweep hot path.
 
-A clean (zero-failure) ``sweep_steady_state`` may perform at most 3
-counted blocking device->host materializations (the ISSUE-3 budget; the
-implementation spends 2: the solve fence and the packed sweep-tail
-diagnostics bundle). On the tunneled production backend each counted
-sync costs ~0.8-1.2 s of round trip regardless of payload, so a PR that
-quietly reintroduces a per-stage ``np.asarray``/``int(jnp.sum(...))``
-pull would tax every sweep; this test makes that a hard failure, and
-tools/lint_host_syncs.py flags the raw idioms statically.
+A clean (zero-failure) ``sweep_steady_state`` may perform at most 2
+counted blocking device->host materializations (tightened from the
+ISSUE-3 budget of 3 by the fused one-dispatch tail, which spends
+exactly 1: the packed diagnostics bundle; the legacy split tail --
+``PYCATKIN_FUSED_SWEEP=0``, fault plans -- spends 2: the solve fence
+plus the packed tail bundle). On the tunneled production backend each
+counted sync costs ~0.8-1.2 s of round trip regardless of payload, so a
+PR that quietly reintroduces a per-stage
+``np.asarray``/``int(jnp.sum(...))`` pull would tax every sweep; this
+test makes that a hard failure, and the PCL001 checker flags the raw
+idioms statically.
 """
 
 import numpy as np
@@ -60,6 +63,47 @@ def test_clean_sweep_with_stability_within_sync_budget(problem):
     assert budget.count <= MAX_CLEAN_SYNCS, (
         f"clean sweep (stability on) spent {budget.count} counted host "
         f"syncs (budget {MAX_CLEAN_SYNCS}): {budget.labels}")
+
+
+def test_fused_clean_sweep_spends_one_sync(problem):
+    """The fused single-dispatch tail's whole clean path is ONE counted
+    sync -- the packed bundle -- and the budget test would not notice a
+    regression to 2, so pin it exactly."""
+    spec, conds, mask = problem
+    sweep_steady_state(spec, conds, tof_mask=mask, check_stability=True)
+    _, budget = _run_clean(spec, conds, mask, check_stability=True)
+    assert budget.count == 1, (
+        f"fused clean sweep spent {budget.count} counted syncs "
+        f"(expected exactly 1): {budget.labels}")
+    assert budget.labels == ["fused tail bundle"]
+
+
+def test_legacy_clean_sweep_within_sync_budget(problem, monkeypatch):
+    """The split tail (fused path disabled) must stay at 2 counted
+    syncs: solve fence + packed tail bundle."""
+    spec, conds, mask = problem
+    monkeypatch.setenv("PYCATKIN_FUSED_SWEEP", "0")
+    sweep_steady_state(spec, conds, tof_mask=mask, check_stability=True)
+    _, budget = _run_clean(spec, conds, mask, check_stability=True)
+    assert budget.count <= MAX_CLEAN_SYNCS, (
+        f"legacy clean sweep spent {budget.count} counted host syncs "
+        f"(budget {MAX_CLEAN_SYNCS}): {budget.labels}")
+    assert "sweep tail bundle" in budget.labels
+
+
+def test_host_sync_pytree_is_one_counted_sync():
+    """A tuple of arrays through host_sync is ONE counted round trip
+    with every leaf returned as numpy (the fused escalation path pulls
+    its masks this way)."""
+    import jax.numpy as jnp
+    profiling.reset_sync_count()
+    a, b = profiling.host_sync((jnp.arange(3.0), jnp.arange(4.0) > 1.0),
+                               "pytree unit test")
+    assert isinstance(a, np.ndarray) and a.shape == (3,)
+    assert isinstance(b, np.ndarray) and b.dtype == bool
+    assert profiling.sync_count() == 1
+    assert profiling.sync_labels() == ["pytree unit test"]
+    profiling.reset_sync_count()
 
 
 def test_sync_counter_counts_and_resets():

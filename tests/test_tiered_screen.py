@@ -1,0 +1,235 @@
+"""Tiered stability certificates + the fused single-dispatch sweep tail.
+
+The fused sweep program (``parallel/batch._fused_sweep_program``)
+computes the solve, the NaN quarantine, the tier-0 stability
+certificate (Gershgorin + deflated Lyapunov), TOF/activity and the
+packed diagnostics bundle in ONE device dispatch; a clean sweep exits
+on ONE counted host sync. These tests pin the contracts that made the
+fusion safe:
+
+- bit-identity with the legacy split pipeline
+  (``PYCATKIN_FUSED_SWEEP=0``) on the clean, no-stability, rescue and
+  tier-2-escalation corpora, and on unstable-seeded lanes that the
+  demote loop must re-solve;
+- tier-0 certificate verdicts agree with the host reference
+  (:func:`solvers.newton.jacobian_eigenvalues_stable`) on every
+  converged lane -- the certificates are sound one-way proofs and the
+  escalation tier IS the reference eigensolve, so agreement is
+  equality, not approximation (adversarial marginal bands within
+  +-1e-10 of the threshold are exercised separately by
+  tests/test_verdicts.py::test_lyapunov_certificate_sound_on_adversarial_matrices);
+- the fused path stands down under an active fault plan (fault
+  poisoning lands on the retried callable's RESULT, which the fused
+  program's in-program quarantine would precede -- legacy semantics
+  are preserved by not fusing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import batch
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         stack_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.solvers.newton import (SolverOptions,
+                                         jacobian_eigenvalues_stable)
+from pycatkin_tpu.utils import profiling
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 48
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(400.0, 800.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def _run_pair(monkeypatch, spec, conds, mask=None, **kwargs):
+    """(fused result, its sync labels, legacy result): the same sweep
+    through the fused dispatch and through the legacy split pipeline."""
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    with profiling.sync_budget() as budget:
+        fused = sweep_steady_state(spec, conds, tof_mask=mask, **kwargs)
+    monkeypatch.setenv("PYCATKIN_FUSED_SWEEP", "0")
+    legacy = sweep_steady_state(spec, conds, tof_mask=mask, **kwargs)
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    return fused, budget.labels, legacy
+
+
+def _assert_bitwise(fused, legacy):
+    assert set(fused) == set(legacy)
+    for k in sorted(fused):
+        a, b = np.asarray(fused[k]), np.asarray(legacy[k])
+        assert a.dtype == b.dtype, k
+        assert a.tobytes() == b.tobytes(), (
+            f"fused/legacy sweep results differ on {k!r}")
+
+
+def test_fused_matches_legacy_clean_corpus(problem, monkeypatch):
+    spec, conds, mask = problem
+    fused, labels, legacy = _run_pair(monkeypatch, spec, conds, mask,
+                                      check_stability=True)
+    assert bool(np.all(np.asarray(fused["success"]))), \
+        "corpus must converge cleanly for this test to mean anything"
+    assert "fused tail bundle" in labels, \
+        "the fused dispatch did not run (env leak?)"
+    _assert_bitwise(fused, legacy)
+
+
+def test_fused_matches_legacy_no_stability(problem, monkeypatch):
+    spec, conds, mask = problem
+    fused, labels, legacy = _run_pair(monkeypatch, spec, conds, mask)
+    assert "fused tail bundle" in labels
+    assert "stable" not in fused
+    _assert_bitwise(fused, legacy)
+
+
+def test_fused_matches_legacy_no_tof(problem, monkeypatch):
+    spec, conds, _ = problem
+    fused, labels, legacy = _run_pair(monkeypatch, spec, conds, None,
+                                      check_stability=True)
+    assert "fused tail bundle" in labels
+    assert "tof" not in fused
+    _assert_bitwise(fused, legacy)
+
+
+def test_fused_matches_legacy_rescue_corpus(problem, monkeypatch):
+    """Crippled pacing fails real lanes in the fast pass: the fused
+    path must reconstruct the raw result and hand it to the exact
+    legacy tail (rescue ladder and all), bit-for-bit."""
+    spec, conds, mask = problem
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    n = np.asarray(conds.T).shape[0]
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    fast = batch._steady_program(spec, batch._fast_pass_opts(opts))(
+        conds, keys, None)
+    assert np.any(~np.asarray(fast.success)), \
+        "corpus produced no failed lanes -- rescue path not exercised"
+    fused, _, legacy = _run_pair(monkeypatch, spec, conds, mask,
+                                 opts=opts, check_stability=True)
+    _assert_bitwise(fused, legacy)
+
+
+def test_tier0_verdicts_agree_with_host_reference(problem, monkeypatch):
+    """For every converged lane the sweep's 'stable' verdict equals the
+    host reference eigensolve's: tier-0 certificates are sound one-way
+    (never certify what the host would reject) and abstaining lanes
+    escalate to the host eigensolve itself, so the tiers can only
+    AGREE with the reference, never drift from it."""
+    spec, conds, mask = problem
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    out = sweep_steady_state(spec, conds, tof_mask=mask,
+                             check_stability=True)
+    assert bool(np.all(np.asarray(out["success"])))
+    ys = jnp.asarray(out["y"])
+    Js = np.asarray(batch._jacobian_program(spec)(conds, ys))
+    stable = np.asarray(out["stable"])
+    for i in range(len(stable)):
+        ref = jacobian_eigenvalues_stable(Js[i])
+        assert bool(stable[i]) == ref, (
+            f"lane {i}: tiered verdict {bool(stable[i])} != host "
+            f"reference {ref}")
+
+
+def test_escalation_matches_legacy_and_is_labeled(problem, monkeypatch):
+    """When tier 0 abstains, the fused sweep must escalate the
+    ambiguous lanes through the batched-mask pull + compacted host
+    eigensolve and still match the legacy two-tier path bitwise.
+
+    The synthetic corpus's dynamic Jacobians keep the column-sum-zero
+    conservation structure, so the Gershgorin column discs certify
+    every lane on their own; to force abstention we pin the TIER-0
+    threshold (the two-argument, device-side call of
+    ``stability_tolerance_from_scale``) far below any Gershgorin/
+    Lyapunov bound while the host tier-2 path (which passes its eps
+    explicitly via ``stability_tolerance``) keeps the real formula --
+    every converged lane then escalates and the host eigensolve still
+    clears it."""
+    from pycatkin_tpu.solvers import newton
+
+    spec, conds, mask = problem
+    orig = newton.stability_tolerance_from_scale
+
+    def tier0_never_certifies(scale, pos_tol=1e-2, eps=None):
+        t = orig(scale, pos_tol, eps)
+        # eps is None only on the device-side tier-0 call sites; the
+        # host tier-2 threshold (stability_tolerance) passes finfo eps.
+        return t - 2.0 * scale if eps is None else t
+
+    # Patch BEFORE the programs trace; the off-default pos_jac_tol
+    # gives this variant fresh cache keys so a previously-compiled
+    # program cannot carry the baked-in real threshold.
+    monkeypatch.setattr(newton, "stability_tolerance_from_scale",
+                        tier0_never_certifies)
+    monkeypatch.setattr(newton, "LYAPUNOV_MAX_DIM", 0)
+    fused, labels, legacy = _run_pair(monkeypatch, spec, conds, mask,
+                                      check_stability=True,
+                                      pos_jac_tol=0.02)
+    assert "fused tail bundle" in labels
+    assert "tier-0 escalation masks" in labels, \
+        "Gershgorin-only screen left nothing ambiguous -- the " \
+        "escalation path was not exercised"
+    assert "tier-2 jacobian" in labels
+    _assert_bitwise(fused, legacy)
+
+
+def test_unstable_seeded_lanes_match_legacy(monkeypatch):
+    """Lanes seeded ON an unstable root converge there, fail the
+    certificate AND the host eigensolve, and must ride the legacy
+    demote/re-solve loop -- identically from the fused entry point."""
+    from tests.test_verdicts import A_STABLE, A_UNSTABLE, _full_y
+    from tests.test_verdicts import bistable as _bistable_fixture
+
+    sim = _bistable_fixture.__wrapped__()
+    spec = sim.spec
+    dyn = np.asarray(spec.dynamic_indices)
+    conds = stack_conditions([sim.conditions()] * 3)
+    x0 = np.stack([_full_y(sim, A_UNSTABLE)[dyn],
+                   _full_y(sim, A_STABLE)[dyn],
+                   _full_y(sim, 0.0)[dyn]])
+    fused, _, legacy = _run_pair(monkeypatch, spec, conds, None,
+                                 x0=jnp.asarray(x0),
+                                 check_stability=True)
+    _assert_bitwise(fused, legacy)
+    # The demotion actually happened: lane 0 escaped the unstable root.
+    assert bool(np.all(np.asarray(fused["success"])))
+    a = np.asarray(fused["y"])[:, spec.sindex("sa")]
+    assert abs(a[0] - A_UNSTABLE) > 1e-3
+    # And the tiered verdict agrees with the host reference on the
+    # unstable seed itself (certificates must never certify it).
+    ys = np.stack([_full_y(sim, A_UNSTABLE), _full_y(sim, A_STABLE),
+                   _full_y(sim, 0.0)])
+    verdicts = np.asarray(batch.stability_mask(spec, conds, ys))
+    Js = np.asarray(batch._jacobian_program(spec)(conds,
+                                                  jnp.asarray(ys)))
+    for i in range(3):
+        assert bool(verdicts[i]) == jacobian_eigenvalues_stable(Js[i])
+    np.testing.assert_array_equal(verdicts, [False, True, True])
+
+
+@pytest.mark.faults
+def test_fused_stands_down_under_fault_plan(problem):
+    """An active fault plan disables the fused dispatch: `on_result`
+    poisoning lands AFTER the fused program's in-program quarantine,
+    which would break the quarantine drill's semantics -- the legacy
+    split tail (whose solve fence precedes the poisoning site) must
+    run instead."""
+    from pycatkin_tpu.robustness import FaultPlan, FaultSpec, fault_scope
+
+    spec, conds, mask = problem
+    # A registered site a plain (unchunked) sweep never dispatches:
+    # the plan stays armed but no fault ever fires.
+    plan = FaultPlan([FaultSpec(site="chunk:0", kind="transient")])
+    with fault_scope(plan):
+        with profiling.sync_budget() as budget:
+            sweep_steady_state(spec, conds, tof_mask=mask,
+                               check_stability=True)
+    assert "fused tail bundle" not in budget.labels
+    assert "sweep tail bundle" in budget.labels

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Ship a warm AOT executable cache between machines/processes.
+
+The prewarm wall (BENCH_r05: 136.6 s cold) is almost entirely XLA
+compilation; the compiled executables are already serialized on disk
+(`parallel/compile_pool.AOTCache`). This tool archives that directory
+into a single shippable pack and re-imports it elsewhere, so a fleet of
+workers -- or the bench after a checkout wipe -- pays the compile wall
+once. Import verifies the aot-key-v2 format, the manifest<->entry spec
+fingerprints, and counts (but keeps) entries from a foreign toolchain,
+which `AOTCache.load` later treats as silent misses.
+
+Usage::
+
+    python tools/aot_pack.py export PACK [--cache-root DIR]
+    python tools/aot_pack.py import PACK [--cache-root DIR] [--no-verify]
+    python tools/aot_pack.py selftest          # CI round-trip gate
+
+`selftest` proves the whole promise end-to-end on a synthetic
+mechanism: prewarm into a fresh cache, export, import into a second
+fresh directory, prewarm again from the pack (asserting ZERO compiles
+-- everything loads), and check the pack-warmed sweep's outputs are
+bit-identical to the freshly-compiled sweep's. Exit 0 iff all holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _cmd_export(args) -> int:
+    from pycatkin_tpu.parallel import compile_pool
+
+    stats = compile_pool.export_cache_pack(args.pack,
+                                           cache_root=args.cache_root)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+def _cmd_import(args) -> int:
+    from pycatkin_tpu.parallel import compile_pool
+
+    stats = compile_pool.import_cache_pack(args.pack,
+                                           cache_root=args.cache_root,
+                                           verify=not args.no_verify)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+def _cmd_selftest(args) -> int:
+    import tempfile
+
+    import numpy as np
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel import compile_pool
+    from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                             clear_program_caches,
+                                             prewarm_sweep_programs,
+                                             sweep_steady_state)
+
+    sim = synthetic_system(n_species=16, n_reactions=24, seed=3)
+    spec = sim.spec
+    n = 32
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(420.0, 780.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    fp = compile_pool.spec_fingerprint(spec)
+    layout = dict(buckets=(8,), check_stability=True)
+
+    def sweep():
+        return sweep_steady_state(spec, conds, tof_mask=mask,
+                                  check_stability=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root_a = os.path.join(tmp, "a")
+        root_b = os.path.join(tmp, "b")
+        pack = os.path.join(tmp, "cache.aotpack.tgz")
+
+        stats_a = prewarm_sweep_programs(
+            spec, conds, tof_mask=mask,
+            cache=compile_pool.AOTCache(root=root_a, fingerprint=fp),
+            **layout)
+        ref = sweep()
+
+        exported = compile_pool.export_cache_pack(pack, cache_root=root_a)
+        print(f"selftest: exported {exported['entries']} entries "
+              f"({exported['bytes']} bytes)")
+        imported = compile_pool.import_cache_pack(pack, cache_root=root_b)
+        if imported["imported"] != exported["entries"]:
+            print("selftest: FAIL -- import lost entries "
+                  f"({imported['imported']} != {exported['entries']})")
+            return 1
+
+        clear_program_caches()
+        stats_b = prewarm_sweep_programs(
+            spec, conds, tof_mask=mask,
+            cache=compile_pool.AOTCache(root=root_b, fingerprint=fp),
+            **layout)
+        if stats_b.compiled != 0 or stats_b.loaded != int(stats_a):
+            print("selftest: FAIL -- pack-warmed prewarm recompiled "
+                  f"(compiled={stats_b.compiled}, loaded={stats_b.loaded}"
+                  f", expected loaded={int(stats_a)})")
+            return 1
+        out = sweep()
+
+        bad = [k for k in sorted(ref)
+               if np.asarray(ref[k]).tobytes()
+               != np.asarray(out[k]).tobytes()]
+        if bad:
+            print(f"selftest: FAIL -- pack-warmed sweep differs on {bad}")
+            return 1
+    print(f"selftest: OK -- {exported['entries']} entries round-tripped, "
+          f"{stats_b.loaded} loaded / 0 compiled from pack, sweep "
+          "bit-identical")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="aot_pack.py",
+        description="Export/import shippable AOT executable cache packs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    exp = sub.add_parser("export", help="archive a warm cache directory")
+    exp.add_argument("pack", help="output pack path (tar.gz)")
+    exp.add_argument("--cache-root", default=None,
+                     help="cache dir (default: PYCATKIN_AOT_CACHE)")
+    exp.set_defaults(fn=_cmd_export)
+    imp = sub.add_parser("import", help="unpack a pack into a cache dir")
+    imp.add_argument("pack", help="pack path")
+    imp.add_argument("--cache-root", default=None)
+    imp.add_argument("--no-verify", action="store_true",
+                     help="skip per-entry verification")
+    imp.set_defaults(fn=_cmd_import)
+    st = sub.add_parser("selftest",
+                        help="prewarm -> export -> import -> bit-identity")
+    st.set_defaults(fn=_cmd_selftest)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
