@@ -234,8 +234,13 @@ def main():
             fresh = os.path.join(tmp, "fresh")
             import_cache_pack(pack, cache_root=fresh)
             clear_program_caches()
-            pack_cache = AOTCache(root=fresh,
-                                  fingerprint=spec_fingerprint(spec))
+            # Under PYCATKIN_ABI=1 cache entries are bound to the
+            # BUCKET fingerprint of the lowered spec, not the
+            # mechanism's.
+            from pycatkin_tpu.frontend.abi import maybe_lower
+            pack_cache = AOTCache(
+                root=fresh,
+                fingerprint=spec_fingerprint(maybe_lower(spec) or spec))
             t0 = time.perf_counter()
             n_prog3 = prewarm_sweep_programs(
                 spec, conds, tof_mask=mask, check_stability=True,
@@ -254,6 +259,31 @@ def main():
         # Cache disabled / empty (e.g. a backend whose executables do
         # not serialize): record the absence, never kill the bench.
         log(f"prewarm warm-from-pack skipped: {e}")
+
+    # ABI marginal prewarm: with PYCATKIN_ABI=1 the zoo keys on the
+    # shape bucket, so a SECOND mechanism landing in the warm bucket
+    # must prewarm with zero fresh compiles (the whole point of the
+    # mechanism ABI). Measured on a thermo-perturbed variant of the
+    # bench mechanism -- same bucket by construction, different
+    # operand values, hence a genuinely different mechanism to the
+    # traced programs. Null when the ABI path is off or unfittable.
+    from pycatkin_tpu.frontend.abi import maybe_lower as _maybe_lower
+    abi_marginal_prewarm_s = None
+    abi_marginal_compiled = None
+    if _maybe_lower(spec) is not None:
+        import dataclasses
+        spec_b = dataclasses.replace(
+            spec, add0=np.asarray(spec.add0) + 0.013)
+        t0 = time.perf_counter()
+        n_prog_b = prewarm_sweep_programs(spec_b, conds, tof_mask=mask,
+                                          check_stability=True,
+                                          verbose=False, mesh=mesh,
+                                          **FULL_PREWARM_LAYOUT)
+        abi_marginal_prewarm_s = time.perf_counter() - t0
+        abi_marginal_compiled = int(n_prog_b.compiled)
+        log(f"ABI marginal prewarm (2nd mechanism, warm bucket): "
+            f"{abi_marginal_prewarm_s:.2f} s, "
+            f"{n_prog_b.compiled} compiled, {n_prog_b.loaded} loaded")
 
     # Warmup sweep on SHIFTED condition values -- the timed runs below
     # must present inputs the device has not seen, so no
@@ -446,6 +476,13 @@ def main():
                                 if prewarm_warm_pack_s is not None
                                 else None),
         "pack": pack_stats,
+        # What a DIFFERENT mechanism in the already-warm ABI bucket
+        # pays (null when PYCATKIN_ABI is off): wall seconds and fresh
+        # compiles -- the latter must be 0, asserted by --smoke.
+        "abi_marginal_prewarm_s": (round(abi_marginal_prewarm_s, 2)
+                                   if abi_marginal_prewarm_s is not None
+                                   else None),
+        "abi_marginal_compiled": abi_marginal_compiled,
         "prewarm_compiled": int(n_prog.compiled),
         "prewarm_loaded": int(n_prog.loaded),
         # Program-zoo diet accounting: total distinct programs the
@@ -561,6 +598,28 @@ def smoke_main():
             out = sweep_steady_state(spec, conds, tof_mask=mask,
                                      check_stability=True)
         wall = time.perf_counter() - t0
+
+        # ABI zero-compile gate (PYCATKIN_ABI=1 only): a second
+        # mechanism landing in the warm bucket must resolve the whole
+        # zoo from the registry -- zero fresh compiles, hard-failed
+        # below like the sync budget. A thermo-perturbed variant is a
+        # different mechanism to the traced programs but shares the
+        # bucket by construction.
+        from pycatkin_tpu.frontend.abi import maybe_lower
+        abi_marginal_prewarm_s = None
+        abi_marginal_compiled = None
+        abi_zero_compile_ok = True
+        if maybe_lower(spec) is not None:
+            import dataclasses
+            spec_b = dataclasses.replace(
+                spec, add0=np.asarray(spec.add0) + 0.013)
+            t0 = time.perf_counter()
+            n_b = prewarm_sweep_programs(spec_b, conds, tof_mask=mask,
+                                         buckets=(8,),
+                                         check_stability=True)
+            abi_marginal_prewarm_s = time.perf_counter() - t0
+            abi_marginal_compiled = int(n_b.compiled)
+            abi_zero_compile_ok = n_b.compiled == 0
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -584,10 +643,20 @@ def smoke_main():
         "sync_labels": budget.labels,
         "max_syncs": max_syncs,
         "sync_budget_ok": not breach,
+        "abi_marginal_prewarm_s": (round(abi_marginal_prewarm_s, 2)
+                                   if abi_marginal_prewarm_s is not None
+                                   else None),
+        "abi_marginal_compiled": abi_marginal_compiled,
+        "abi_zero_compile_ok": abi_zero_compile_ok,
         "lint_ok": True,
         "lint_findings": 0,
     }
     print(json.dumps(result))
+    if not abi_zero_compile_ok:
+        log(f"bench-smoke: FAIL -- second mechanism in the warm ABI "
+            f"bucket compiled {abi_marginal_compiled} program(s) "
+            f"(must be 0 under PYCATKIN_ABI=1)")
+        return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "
             f"(smoke prewarmed {int(n_prog)}, full bench layout "

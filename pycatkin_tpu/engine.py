@@ -81,7 +81,7 @@ def free_energies(spec: ModelSpec, cond: Conditions) -> FreeEnergies:
 
     mods = spec.add0 + cond.eps
     g0 = e_full + gv + gt + gr + mods
-    if spec.udar_mask.any():
+    if spec.has_udar:
         # use_descriptor_as_reactant free-energy assembly
         # (reference state.py:519-565).
         corr = (spec.udar_Ce @ e_full + spec.udar_Cg @ g0 +
@@ -89,7 +89,7 @@ def free_energies(spec: ModelSpec, cond: Conditions) -> FreeEnergies:
         g = jnp.where(spec.udar_mask > 0, e_full + corr + mods, g0)
     else:
         g = g0
-    if spec.gfree_mask.any():
+    if spec.has_gfree:
         g = jnp.where(spec.gfree_mask > 0, spec.gfree0 + mods, g)
     return FreeEnergies(gelec=e_full, gfree=g, gvibr=gv, gtran=gt, grota=gr)
 
@@ -226,11 +226,20 @@ def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr):
     plus the per-species gross-flux scale, computed in one pass (the
     solver's net-vs-gross convergence measure)."""
     dyn, static, y_base = _dynamic_setup(spec, cond)
+    # ABI-padded specs carry a dynamic validity mask; pad slots get the
+    # exactly-decoupled residual x' = -x, so the padded Jacobian is
+    # blkdiag(J_real, -I): real solutions, verdicts and certificates
+    # match the unpadded system bit-for-bit.
+    dyn_mask = getattr(spec, "dyn_mask", None)
 
     def fscale(x):
         y = y_base.at[dyn].set(x)
         F, gross = network.reactor_rhs_and_scale(y, 0.0, kf, kr, **static)
-        return F[dyn], gross[dyn]
+        F, gross = F[dyn], gross[dyn]
+        if dyn_mask is not None:
+            F = jnp.where(dyn_mask > 0, F, -x)
+            gross = jnp.where(dyn_mask > 0, gross, 1.0)
+        return F, gross
     return fscale, dyn, y_base
 
 

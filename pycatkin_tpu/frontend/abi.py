@@ -1,0 +1,534 @@
+"""Mechanism ABI: shape-bucketed traced-operand specs (ROADMAP item 3).
+
+Every program in the zoo historically closed over ``ModelSpec``'s numpy
+arrays as XLA constants, so each new mechanism re-paid the full prewarm
+wall and AOT packs were valid for exactly one mechanism. This module
+inverts that contract: a mechanism's dense operands (stoichiometry,
+index tables, thermo tables, reaction masks) are zero-padded into a
+small set of static shape buckets and threaded through the programs as
+a *traced argument*. Programs then specialize only on the bucket --
+``AbiStatic`` -- and the second mechanism that lands in a warm bucket
+runs with zero new compiles.
+
+Object model (three layers):
+
+``AbiStatic``
+    The bucket: padded species/reaction/dynamic dims plus the two
+    genuinely trace-shaping scalars (reactor code, desorption model).
+    Everything a compiled program is allowed to specialize on.
+
+``AbiProgramSpec``
+    One interned instance per ``AbiStatic``. This is what the program
+    builders, the compile-pool registry and ``spec_fingerprint`` see in
+    place of a ``ModelSpec`` -- its identity (and ``abi_fingerprint``)
+    is shared by every mechanism in the bucket, which is exactly what
+    makes the caches cross-mechanism. ``bind(ops)`` reconstitutes a
+    spec-shaped namespace from traced operands inside a program body.
+
+``AbiLowered``
+    One per mechanism: the zero-padded ``ModelSpec`` (host-side
+    orchestration reads fall through to it), the operand pytree, and
+    the padding/unpadding helpers for conditions and results.
+
+Padding semantics (proven exact no-ops, see docs/mechanism_abi.md):
+pad reactions are ghosts (``is_ghost=1`` -> kf=kr=0); pad species have
+zero stoichiometry rows and zero thermo masks; the legacy activity
+sentinel ``n_s`` is remapped to the padded sentinel ``S``; pad dynamic
+slots point at the last (pad) species slot and carry an ``x' = -x``
+residual via ``dyn_mask`` so the padded Jacobian is exactly
+``blkdiag(J_real, -I)``.
+
+This module keeps jax imports function-local so the bucket tables can
+be imported by the (host-only) validation layer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import fields as _dc_fields
+from typing import NamedTuple
+
+import numpy as np
+
+from .spec import REACTOR_CSTR, Conditions, ModelSpec
+
+ABI_VERSION = 1
+ABI_ENV = "PYCATKIN_ABI"
+
+# Primary buckets: padded species dim S (>= n_s + 1: the last slot is
+# reserved so pad dynamic/scaling indices never alias a real species)
+# and padded reaction dim R (>= n_r).
+SPECIES_BUCKETS = (16, 32, 128, 512)
+REACTION_BUCKETS = (16, 64, 256, 1024)
+
+# Secondary dims, fixed across ALL buckets so mechanisms differing only
+# in their small dims (frequency count, reaction arity, conservation
+# groups, scaling states) still land in the same program. A mechanism
+# exceeding any of these falls back to the legacy constant-folded path.
+FREQ_PAD = 32        # F: vibrational modes per species
+ARITY_PAD = 6        # A: reac_idx / prod_idx width
+GROUPS_PAD = 8       # n_g: site-conservation groups
+SCALING_PAD = 8      # n_sc: linear-scaling states
+LYAP_PAD = 4         # m: deflated dim of the Lyapunov certificate basis
+
+# The dynamic dim is its own power-of-two sub-bucket (solver cost is
+# cubic in it; tying it to S would be ruinous for small mechanisms).
+_BOUNDARY_MARGIN = 0.05   # validate.py warns within 5% of a bucket edge
+
+
+class AbiStatic(NamedTuple):
+    """Everything a compiled ABI program may specialize on."""
+    abi_version: int
+    n_species: int       # S (padded, includes the reserved pad slot)
+    n_reactions: int     # R (padded)
+    n_dynamic: int       # D (padded dynamic dim)
+    reactor_type: int
+    desorption_model: str
+
+
+def abi_fingerprint_of(static: AbiStatic) -> str:
+    return ("abi-v{0}:s{1}:r{2}:d{3}:rt{4}:{5}".format(
+        static.abi_version, static.n_species, static.n_reactions,
+        static.n_dynamic, static.reactor_type, static.desorption_model))
+
+
+def abi_enabled() -> bool:
+    return os.environ.get(ABI_ENV, "0").lower() not in ("", "0", "false")
+
+
+class AbiBucketError(ValueError):
+    """A mechanism does not fit any ABI bucket. Carries a
+    ``ValidationReport``-style diagnostic in ``.report``."""
+
+    def __init__(self, issues):
+        from .validate import ValidationReport
+        report = ValidationReport()
+        for loc, msg in issues:
+            report.error(loc, msg)
+        self.report = report
+        lines = ["mechanism does not fit the ABI buckets:"]
+        lines += [f"  {i.location}: {i.message}" for i in report.issues]
+        super().__init__("\n".join(lines))
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < max(int(n), 1):
+        p *= 2
+    return p
+
+
+def _bucket_for(n: int, buckets) -> int | None:
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    return None
+
+
+def select_static(spec: ModelSpec, species_bucket: int | None = None,
+                  reaction_bucket: int | None = None) -> AbiStatic:
+    """Pick the bucket for ``spec`` (or validate a forced one), raising
+    :class:`AbiBucketError` with a per-dimension diagnostic when the
+    mechanism cannot fit."""
+    n_s, n_r = spec.n_species, spec.n_reactions
+    issues = []
+
+    S = species_bucket or _bucket_for(n_s + 1, SPECIES_BUCKETS)
+    if S is None or n_s + 1 > S:
+        issues.append((
+            "/abi/species",
+            f"{n_s} species (+1 reserved pad slot) exceed "
+            f"bucket {S or max(SPECIES_BUCKETS)}"))
+    R = reaction_bucket or _bucket_for(n_r, REACTION_BUCKETS)
+    if R is None or n_r > R:
+        issues.append((
+            "/abi/reactions",
+            f"{n_r} reactions exceed bucket {R or max(REACTION_BUCKETS)}"))
+    if spec.freq.shape[1] > FREQ_PAD:
+        issues.append(("/abi/freq",
+                       f"{spec.freq.shape[1]} vibrational modes exceed "
+                       f"the fixed pad {FREQ_PAD}"))
+    if spec.reac_idx.shape[1] > ARITY_PAD:
+        issues.append(("/abi/arity",
+                       f"reaction arity {spec.reac_idx.shape[1]} exceeds "
+                       f"the fixed pad {ARITY_PAD}"))
+    if spec.groups.shape[0] > GROUPS_PAD:
+        issues.append(("/abi/groups",
+                       f"{spec.groups.shape[0]} conservation groups exceed "
+                       f"the fixed pad {GROUPS_PAD}"))
+    if spec.scl_idx.size > SCALING_PAD:
+        issues.append(("/abi/scaling",
+                       f"{spec.scl_idx.size} scaling states exceed "
+                       f"the fixed pad {SCALING_PAD}"))
+    if issues:
+        raise AbiBucketError(issues)
+
+    n_dyn = int(np.asarray(spec.dynamic_indices).size)
+    D = _pow2_at_least(n_dyn)
+    m = _deflated_dim(spec)
+    if 0 < m <= LYAP_PAD:
+        # The Lyapunov basis needs LYAP_PAD - m distinct pad dynamic
+        # slots for its unit pad columns (QtJQ = blkdiag(B, -I)).
+        while D - n_dyn < LYAP_PAD - m:
+            D *= 2
+    return AbiStatic(abi_version=ABI_VERSION, n_species=S, n_reactions=R,
+                     n_dynamic=D, reactor_type=int(spec.reactor_type),
+                     desorption_model=str(spec.desorption_model))
+
+
+def _deflated_dim(spec: ModelSpec) -> int:
+    from ..solvers.newton import deflation_basis_for_spec
+    return int(deflation_basis_for_spec(spec).shape[1])
+
+
+# ----------------------------------------------------------------------
+# TracedSpec: the spec-shaped namespace programs run on
+
+class TracedSpec:
+    """Duck-typed ``ModelSpec`` built inside a jitted program body from
+    ``(AbiStatic, traced operands)``. The engine runs on it unchanged;
+    the always-on scaling/udar/gfree blocks are exact no-ops for
+    mechanisms that lack them (their padded matrices are zero)."""
+
+    has_udar = True
+    has_gfree = True
+
+    def __init__(self, static: AbiStatic, ops: dict):
+        self.abi_static = static
+        self.reactor_type = static.reactor_type
+        self.desorption_model = static.desorption_model
+        for k, v in ops.items():
+            setattr(self, k, v)
+
+    @property
+    def n_species(self) -> int:
+        return self.abi_static.n_species
+
+    @property
+    def n_reactions(self) -> int:
+        return self.abi_static.n_reactions
+
+
+class AbiProgramSpec:
+    """The bucket-identity object handed to program builders and the
+    compile pool in place of a ``ModelSpec``. Interned: one instance
+    per ``AbiStatic``, and hash/eq by bucket, so identity-keyed builder
+    caches and the executable registry are shared by every mechanism
+    that lowers into the bucket."""
+
+    def __init__(self, static: AbiStatic):
+        self.static = static
+        self.abi_fingerprint = abi_fingerprint_of(static)
+
+    def bind(self, ops: dict) -> TracedSpec:
+        return TracedSpec(self.static, ops)
+
+    def __hash__(self):
+        return hash(self.static)
+
+    def __eq__(self, other):
+        return (isinstance(other, AbiProgramSpec)
+                and self.static == other.static)
+
+    def __repr__(self):
+        return f"AbiProgramSpec({self.abi_fingerprint})"
+
+
+_PROGRAM_SPECS: dict = {}
+_PS_LOCK = threading.Lock()
+
+
+def program_spec_for(static: AbiStatic) -> AbiProgramSpec:
+    with _PS_LOCK:
+        ps = _PROGRAM_SPECS.get(static)
+        if ps is None:
+            ps = _PROGRAM_SPECS[static] = AbiProgramSpec(static)
+        return ps
+
+
+# ----------------------------------------------------------------------
+# lowering: ModelSpec -> AbiLowered
+
+def _pad_to(a, shape, fill=0.0):
+    """Zero-extend ``a`` (trailing pads, value ``fill``) to ``shape``."""
+    a = np.asarray(a)
+    widths = [(0, t - s) for s, t in zip(a.shape, shape)]
+    return np.pad(a, widths, constant_values=np.asarray(fill, a.dtype))
+
+
+def _padded_spec(spec: ModelSpec, st: AbiStatic) -> ModelSpec:
+    """The zero-padded host-side ModelSpec for a bucket. Pad rules:
+
+    - pad reactions are ghosts (kf=kr=0) with neutral physical fields
+      (area/masses 1.0 so no log/0-division paths are fed zeros);
+    - pad species have zero thermo masks, zero stoichiometry rows and
+      unit mass/sigma/inertia;
+    - index tables remap the legacy activity sentinel n_s -> S and send
+      pad entries to S (reac/prod) or S-1 (scaling/dynamic scatter
+      targets, which land in the reserved pad species slot).
+    """
+    S, R = st.n_species, st.n_reactions
+    n_s, n_r = spec.n_species, spec.n_reactions
+    F, A = FREQ_PAD, ARITY_PAD
+
+    reac_idx = np.asarray(spec.reac_idx).copy()
+    prod_idx = np.asarray(spec.prod_idx).copy()
+    reac_idx[reac_idx == n_s] = S
+    prod_idx[prod_idx == n_s] = S
+
+    n_dyn = int(np.asarray(spec.dynamic_indices).size)
+    dyn = _pad_to(spec.dynamic_indices, (st.n_dynamic,), S - 1)
+    pad_sp = [f"__abi_pad_s{i}" for i in range(S - n_s)]
+    pad_rx = [f"__abi_pad_r{i}" for i in range(R - n_r)]
+
+    kw = dict(
+        snames=tuple(spec.snames) + tuple(pad_sp),
+        state_types=tuple(spec.state_types) + ("abi_pad",) * (S - n_s),
+        freq=_pad_to(spec.freq, (S, F)),
+        fmask=_pad_to(spec.fmask, (S, F)),
+        mass=_pad_to(spec.mass, (S,), 1.0),
+        sigma=_pad_to(spec.sigma, (S,), 1.0),
+        inertia=_pad_to(spec.inertia, (S, 3), 1.0),
+        is_gas=_pad_to(spec.is_gas, (S,)),
+        is_linear=_pad_to(spec.is_linear, (S,)),
+        mix=_pad_to(spec.mix, (S, S)),
+        gelec0=_pad_to(spec.gelec0, (S,)),
+        add0=_pad_to(spec.add0, (S,)),
+        gvibr0=_pad_to(spec.gvibr0, (S,)),
+        gvibr_mask=_pad_to(spec.gvibr_mask, (S,)),
+        gtran0=_pad_to(spec.gtran0, (S,)),
+        gtran_mask=_pad_to(spec.gtran_mask, (S,)),
+        grota0=_pad_to(spec.grota0, (S,)),
+        grota_mask=_pad_to(spec.grota_mask, (S,)),
+        gfree0=_pad_to(spec.gfree0, (S,)),
+        gfree_mask=_pad_to(spec.gfree_mask, (S,)),
+        scl_idx=_pad_to(spec.scl_idx, (SCALING_PAD,), S - 1),
+        scl_b=_pad_to(spec.scl_b, (SCALING_PAD,)),
+        scl_We=_pad_to(spec.scl_We, (SCALING_PAD, S)),
+        scl_Ws=_pad_to(spec.scl_Ws, (SCALING_PAD, SCALING_PAD)),
+        scl_WuE=_pad_to(spec.scl_WuE, (SCALING_PAD, R)),
+        udar_mask=_pad_to(spec.udar_mask, (S,)),
+        udar_Ce=_pad_to(spec.udar_Ce, (S, S)),
+        udar_Cg=_pad_to(spec.udar_Cg, (S, S)),
+        udar_CuE=_pad_to(spec.udar_CuE, (S, R)),
+        udar_CuG=_pad_to(spec.udar_CuG, (S, R)),
+        rnames=tuple(spec.rnames) + tuple(pad_rx),
+        reac_types=tuple(spec.reac_types) + ("abi_pad",) * (R - n_r),
+        SR=_pad_to(spec.SR, (R, S)),
+        SP=_pad_to(spec.SP, (R, S)),
+        ST=_pad_to(spec.ST, (R, S)),
+        has_TS=_pad_to(spec.has_TS, (R,)),
+        reversible=_pad_to(spec.reversible, (R,)),
+        base_reversible=_pad_to(spec.base_reversible, (R,)),
+        is_arr_type=_pad_to(spec.is_arr_type, (R,)),
+        is_ads=_pad_to(spec.is_ads, (R,)),
+        is_des=_pad_to(spec.is_des, (R,)),
+        is_ghost=_pad_to(spec.is_ghost, (R,), 1.0),
+        is_user=_pad_to(spec.is_user, (R,)),
+        area=_pad_to(spec.area, (R,), 1.0),
+        rscaling=_pad_to(spec.rscaling, (R,), 1.0),
+        site_density=_pad_to(spec.site_density, (R,)),
+        gas_mass=_pad_to(spec.gas_mass, (R,), 1.0),
+        gas_sigma=_pad_to(spec.gas_sigma, (R,), 1.0),
+        gas_inertia=_pad_to(spec.gas_inertia, (R, 3), 1.0),
+        gas_polyatomic=_pad_to(spec.gas_polyatomic, (R,)),
+        reac_idx=_pad_to(reac_idx, (R, A), S),
+        prod_idx=_pad_to(prod_idx, (R, A), S),
+        stoich=_pad_to(spec.stoich, (S, R)),
+        reactor_type=spec.reactor_type,
+        volume=spec.volume,
+        catalyst_area=spec.catalyst_area,
+        residence_time=spec.residence_time,
+        is_adsorbate=_pad_to(spec.is_adsorbate, (S,)),
+        is_gas_dyn=_pad_to(spec.is_gas_dyn, (S,)),
+        dynamic_indices=dyn,
+        adsorbate_indices=np.asarray(spec.adsorbate_indices).copy(),
+        gas_indices=np.asarray(spec.gas_indices).copy(),
+        groups=_pad_to(spec.groups, (GROUPS_PAD, S)),
+        desorption_model=spec.desorption_model,
+    )
+    missing = {f.name for f in _dc_fields(ModelSpec)} - set(kw)
+    if missing:   # a new ModelSpec field must pick a pad rule explicitly
+        raise AbiBucketError([("/abi/fields",
+                               f"no ABI pad rule for spec fields "
+                               f"{sorted(missing)} (bump ABI_VERSION)")])
+    assert n_dyn <= st.n_dynamic
+    return ModelSpec(**kw)
+
+
+# Padded-spec array fields that become traced operands. Host-only /
+# build-time fields (gelec0, is_arr_type, base_reversible, rscaling,
+# site_density, is_gas_dyn, adsorbate/gas index lists) stay off the
+# operand pytree.
+_OPERAND_FIELDS = (
+    "freq", "fmask", "mass", "sigma", "inertia", "is_gas", "is_linear",
+    "mix", "add0", "gvibr0", "gvibr_mask", "gtran0", "gtran_mask",
+    "grota0", "grota_mask", "gfree0", "gfree_mask",
+    "scl_idx", "scl_b", "scl_We", "scl_Ws", "scl_WuE",
+    "udar_mask", "udar_Ce", "udar_Cg", "udar_CuE", "udar_CuG",
+    "SR", "SP", "ST", "has_TS", "reversible", "is_ads", "is_des",
+    "is_ghost", "is_user", "area", "gas_mass", "gas_sigma",
+    "gas_inertia", "gas_polyatomic", "reac_idx", "prod_idx", "stoich",
+    "is_adsorbate", "dynamic_indices", "groups",
+)
+
+
+def _lyapunov_operands(spec: ModelSpec, st: AbiStatic):
+    """Padded deflation basis Q [D, LYAP_PAD] and its validity flag.
+
+    The real basis (computed from the ORIGINAL spec, so its real block
+    is bit-identical to the legacy screen's) is extended with unit
+    columns on distinct pad dynamic slots, making QtJQ =
+    blkdiag(B_real, -I): the certificate's verdict on the padded system
+    equals its verdict on the real one. When the real deflated dim
+    exceeds LYAP_PAD (or is 0), lyap_ok=0 soundly abstains and those
+    lanes take the tier-2 eigensolve, exactly like legacy mechanisms
+    above LYAPUNOV_MAX_DIM."""
+    from ..solvers.newton import deflation_basis_for_spec
+    n_dyn = int(np.asarray(spec.dynamic_indices).size)
+    Q_real = np.asarray(deflation_basis_for_spec(spec), dtype=np.float64)
+    m = Q_real.shape[1]
+    Q = np.zeros((st.n_dynamic, LYAP_PAD), dtype=np.float64)
+    ok = 0 < m <= LYAP_PAD and (st.n_dynamic - n_dyn) >= (LYAP_PAD - m)
+    if ok:
+        Q[:n_dyn, :m] = Q_real
+        for j in range(LYAP_PAD - m):
+            Q[n_dyn + j, m + j] = 1.0
+    return Q, np.float64(1.0 if ok else 0.0)
+
+
+class AbiLowered:
+    """One mechanism lowered into a bucket: the padded host-side spec,
+    the traced operand pytree, and the pad/unpad helpers. Host
+    attribute reads fall through to the padded ``ModelSpec``."""
+
+    def __init__(self, base: ModelSpec, static: AbiStatic):
+        self.base = base
+        self.static = static
+        self.spec_padded = _padded_spec(base, static)
+        self.program_spec = program_spec_for(static)
+        self.abi_fingerprint = self.program_spec.abi_fingerprint
+        self.n_s_real = base.n_species
+        self.n_r_real = base.n_reactions
+        self.n_dyn_real = int(np.asarray(base.dynamic_indices).size)
+
+        ops = {k: np.asarray(getattr(self.spec_padded, k))
+               for k in _OPERAND_FIELDS}
+        dyn_mask = np.zeros((static.n_dynamic,), dtype=np.float64)
+        dyn_mask[:self.n_dyn_real] = 1.0
+        ops["dyn_mask"] = dyn_mask
+        ops["lyap_q"], ops["lyap_ok"] = _lyapunov_operands(base, static)
+        if static.reactor_type == REACTOR_CSTR:
+            ops["volume"] = np.float64(base.volume)
+            ops["catalyst_area"] = np.float64(base.catalyst_area)
+            ops["residence_time"] = np.float64(base.residence_time)
+        self._np_operands = {k: ops[k] for k in sorted(ops)}
+        self._device_operands = None
+
+    def operands(self) -> dict:
+        """The traced operand pytree (device arrays, cached)."""
+        if self._device_operands is None:
+            import jax.numpy as jnp
+            self._device_operands = {
+                k: jnp.asarray(v) for k, v in self._np_operands.items()}
+        return self._device_operands
+
+    def __getattr__(self, name):
+        return getattr(self.spec_padded, name)
+
+    # -- boundary padding -------------------------------------------------
+    def pad_conditions(self, conds: Conditions) -> Conditions:
+        S, R = self.static.n_species, self.static.n_reactions
+        sp = lambda a, fill=0.0: _pad_last(a, S - self.n_s_real, fill)
+        rx = lambda a, fill=0.0: _pad_last(a, R - self.n_r_real, fill)
+        return conds._replace(
+            gelec=sp(conds.gelec), eps=sp(conds.eps), y0=sp(conds.y0),
+            inflow=sp(conds.inflow),
+            uE_rxn=rx(conds.uE_rxn), uG_rxn=rx(conds.uG_rxn),
+            uEa=rx(conds.uEa), uGa=rx(conds.uGa),
+            u_rxn_mask=rx(conds.u_rxn_mask), u_bar_mask=rx(conds.u_bar_mask),
+            is_activated=rx(conds.is_activated),
+            kscale=rx(conds.kscale, 1.0))
+
+    def pad_x0(self, x0):
+        if x0 is None:
+            return None
+        return _pad_last(x0, self.static.n_dynamic - self.n_dyn_real, 0.0)
+
+    def pad_tof_mask(self, mask):
+        if mask is None:
+            return None
+        return _pad_last(mask, self.static.n_reactions - self.n_r_real, 0.0)
+
+    def unpad_y(self, y):
+        """Strip pad species from a [..., S] composition axis."""
+        return y[..., :self.n_s_real]
+
+
+def _pad_last(a, pad: int, fill):
+    a = np.asarray(a)
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+    return np.pad(a, widths, constant_values=np.asarray(fill, a.dtype))
+
+
+# ----------------------------------------------------------------------
+# gating
+
+_LOWER_CACHE: dict = {}
+_LOWER_LOCK = threading.Lock()
+_FALLBACK_WARNED: set = set()
+
+
+def lower_spec(spec: ModelSpec, species_bucket: int | None = None,
+               reaction_bucket: int | None = None) -> AbiLowered:
+    """Lower ``spec`` into its ABI bucket (cached per spec identity for
+    the default-bucket case; forced buckets are not cached)."""
+    if species_bucket is None and reaction_bucket is None:
+        with _LOWER_LOCK:
+            low = _LOWER_CACHE.get(spec)
+        if low is not None:
+            return low
+    st = select_static(spec, species_bucket, reaction_bucket)
+    low = AbiLowered(spec, st)
+    if species_bucket is None and reaction_bucket is None:
+        # Headroom advisory (once per mechanism, thanks to the cache):
+        # landing within _BOUNDARY_MARGIN of the bucket edge means tiny
+        # mechanism growth will spill into the next bucket and repay
+        # the compile wall the ABI amortizes.
+        from .validate import check_abi_headroom
+        import warnings
+        for issue in check_abi_headroom(spec).warnings:
+            warnings.warn(f"mechanism ABI: {issue}", UserWarning,
+                          stacklevel=3)
+        with _LOWER_LOCK:
+            _LOWER_CACHE[spec] = low
+    return low
+
+
+def maybe_lower(spec):
+    """The batch-layer gate: returns an :class:`AbiLowered` when the
+    ABI path is enabled and ``spec`` fits a bucket, else None (legacy
+    constant-folded path; unfittable mechanisms warn once)."""
+    if not abi_enabled() or not isinstance(spec, ModelSpec):
+        return None
+    try:
+        return lower_spec(spec)
+    except AbiBucketError as e:
+        key = id(spec)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            import warnings
+            warnings.warn(
+                f"PYCATKIN_ABI=1 but the mechanism does not fit any ABI "
+                f"bucket; falling back to the legacy constant-folded "
+                f"programs. {e}", stacklevel=3)
+        return None
+
+
+def clear_lowering_cache():
+    with _LOWER_LOCK:
+        _LOWER_CACHE.clear()
+    _FALLBACK_WARNED.clear()

@@ -143,6 +143,25 @@ class ModelSpec:
     def n_reactions(self) -> int:
         return len(self.rnames)
 
+    @property
+    def has_udar(self) -> bool:
+        # Static use_descriptor_as_reactant gate; the ABI's TracedSpec
+        # overrides this with an always-True class attribute (its padded
+        # correction matrices make the block an exact no-op).
+        return bool(np.asarray(self.udar_mask).any())
+
+    @property
+    def has_gfree(self) -> bool:
+        return bool(np.asarray(self.gfree_mask).any())
+
+    def to_abi(self, species_bucket: int | None = None,
+               reaction_bucket: int | None = None):
+        """Lower this mechanism into its ABI shape bucket (see
+        frontend/abi.py); raises AbiBucketError when it cannot fit."""
+        from .abi import lower_spec
+        return lower_spec(self, species_bucket=species_bucket,
+                          reaction_bucket=reaction_bucket)
+
     def sindex(self, name: str) -> int:
         return self.snames.index(name)
 

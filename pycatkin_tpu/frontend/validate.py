@@ -310,3 +310,44 @@ def validate_system(system, source: str | None = None) -> ValidationReport:
                 report.error(loc,
                              "only gas states can comprise the inflow")
     return report
+
+
+def check_abi_headroom(spec, report: ValidationReport | None = None
+                       ) -> ValidationReport:
+    """Warn when a BUILT mechanism lands within the boundary margin
+    (frontend/abi.py ``_BOUNDARY_MARGIN``, 5%) of its ABI shape
+    bucket's edge. A mechanism hugging the boundary is one species or
+    a few reactions away from spilling into the next bucket -- which
+    under ``PYCATKIN_ABI=1`` means new program identities and the full
+    compile/prewarm wall again, exactly the cost the ABI exists to
+    amortize. Runs on a :class:`~pycatkin_tpu.frontend.spec.ModelSpec`
+    (the counts the bucket selector sees), unlike the host-object
+    checks above; :func:`pycatkin_tpu.frontend.abi.lower_spec` emits
+    these warnings once per mechanism."""
+    from .abi import (_BOUNDARY_MARGIN, REACTION_BUCKETS, SPECIES_BUCKETS,
+                      _bucket_for)
+    if report is None:
+        report = ValidationReport()
+    pct = int(round(_BOUNDARY_MARGIN * 100))
+    for loc, n, buckets, what in (
+            ("/abi/species", spec.n_species + 1, SPECIES_BUCKETS,
+             "species (incl. the reserved pad slot)"),
+            ("/abi/reactions", spec.n_reactions, REACTION_BUCKETS,
+             "reactions")):
+        b = _bucket_for(n, buckets)
+        if b is None:
+            continue            # unfittable: lowering raises, not warns
+        if n > b * (1.0 - _BOUNDARY_MARGIN):
+            report.warn(
+                loc,
+                f"{n} {what} is within {pct}% of the ABI bucket "
+                f"boundary {b}; slight mechanism growth spills into "
+                f"the next bucket (padded shape {loc.rsplit('/', 1)[-1]}"
+                f"={b} -> {_next_bucket(b, buckets)}) and repays the "
+                f"full compile/prewarm wall")
+    return report
+
+
+def _next_bucket(b: int, buckets) -> object:
+    larger = [x for x in buckets if x > b]
+    return min(larger) if larger else "unfittable"

@@ -18,6 +18,10 @@ PCL004    tracer-leak       no Python control flow / np.* host calls on
 PCL005    dtype-discipline  no hardcoded float64 in ops/ and solvers/
 PCL006    env-registry      every PYCATKIN_* env key documented in
                             docs/index.md
+PCL007    abi-spec-capture  no spec.<array> numpy reads inside
+                            program-builder closures in
+                            parallel/batch.py (use the bound
+                            TracedSpec; docs/mechanism_abi.md)
 ========  ================  =============================================
 
 Suppressions: inline ``# pclint: disable=<rule> -- <reason>`` (any line

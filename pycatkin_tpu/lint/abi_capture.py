@@ -1,0 +1,142 @@
+"""PCL007 abi-spec-capture: program-builder closures in
+``parallel/batch.py`` must not read ``spec.<array>`` numpy fields.
+
+The mechanism ABI (frontend/abi.py) exists because program bodies that
+close over a ``ModelSpec``'s numpy arrays constant-fold them into the
+compiled executable -- the program's identity then includes the
+mechanism, every new mechanism re-pays the compile wall, and AOT packs
+serve exactly one mechanism. An ABI program body instead reads those
+arrays from the ``TracedSpec`` bound to its traced operands
+(``tspec = spec.bind(ops)``), so one executable serves every mechanism
+in the shape bucket.
+
+This rule pins that boundary statically: inside any top-level
+``*_program`` builder in ``parallel/batch.py``, a nested function or
+lambda (the closure that becomes the jitted program body) reading a
+known ModelSpec ARRAY field off the builder's ``spec`` parameter is a
+finding. Scalar statics (``n_species``, ``reactor_type``,
+``rnames``...) are trace-shaping by design and stay legal, as do array
+reads in the builder's own (host-side, trace-time) body -- only reads
+*inside the closure* become baked XLA constants.
+
+The legacy constant-folded branches of the builders do exactly this on
+purpose (they are the ``PYCATKIN_ABI=0`` path); those survivors live in
+the committed ``lint_baseline.json``, so the rule's job is to stop NEW
+program bodies from quietly re-growing mechanism-keyed constants.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+# ModelSpec numpy-array fields (frontend/spec.py): the operand pytree
+# fields of frontend.abi._OPERAND_FIELDS plus the host-only arrays.
+# Kept as a literal so the linter imports no package code (core.py
+# contract); test_pclint.py cross-checks it against the dataclass.
+SPEC_ARRAY_FIELDS = frozenset({
+    "freq", "fmask", "mass", "sigma", "inertia", "is_gas", "is_linear",
+    "mix", "gelec0", "add0", "gvibr0", "gvibr_mask", "gtran0",
+    "gtran_mask", "grota0", "grota_mask", "gfree0", "gfree_mask",
+    "scl_idx", "scl_b", "scl_We", "scl_Ws", "scl_WuE",
+    "udar_mask", "udar_Ce", "udar_Cg", "udar_CuE", "udar_CuG",
+    "SR", "SP", "ST", "has_TS", "reversible", "base_reversible",
+    "is_arr_type", "is_ads", "is_des", "is_ghost", "is_user", "area",
+    "rscaling", "site_density", "gas_mass", "gas_sigma", "gas_inertia",
+    "gas_polyatomic", "reac_idx", "prod_idx", "stoich", "is_adsorbate",
+    "is_gas_dyn", "dynamic_indices", "adsorbate_indices", "gas_indices",
+    "groups",
+})
+
+_BUILDER_SUFFIX = "_program"
+
+
+def _spec_param(fn: ast.FunctionDef) -> str | None:
+    """The builder's spec parameter name ('spec' by convention), None
+    when the builder takes no such argument."""
+    for a in fn.args.posonlyargs + fn.args.args:
+        if a.arg == "spec":
+            return a.arg
+    return None
+
+
+def _param_names(fn) -> set:
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+class _ClosureScan(ast.NodeVisitor):
+    """Collect ``spec.<array>`` attribute reads inside nested
+    functions/lambdas of one builder, skipping scopes that rebind or
+    shadow the spec name (their ``spec`` is not the builder's)."""
+
+    def __init__(self, spec_name: str):
+        self.spec_name = spec_name
+        self.hits: list = []
+        self._depth = 0        # >0 once inside a nested function
+
+    def _enter(self, node, body):
+        if self.spec_name in _param_names(node):
+            return             # shadowed: not the builder's spec
+        self._depth += 1
+        for child in body:
+            self.visit(child)
+        self._depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._enter(node, node.body)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._enter(node, [node.body])
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (self._depth > 0
+                and isinstance(node.value, ast.Name)
+                and node.value.id == self.spec_name
+                and node.attr in SPEC_ARRAY_FIELDS):
+            self.hits.append(node)
+        self.generic_visit(node)
+
+
+@register
+class AbiCaptureChecker(Checker):
+    rule = "PCL007"
+    name = "abi-spec-capture"
+    description = ("program-builder closure captures a spec.<array> "
+                   "numpy field as an XLA constant (read it from the "
+                   "bound TracedSpec instead)")
+    scope = ("pycatkin_tpu/parallel/batch.py",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for top in src.tree.body:
+            if not isinstance(top, ast.FunctionDef):
+                continue
+            if not top.name.endswith(_BUILDER_SUFFIX):
+                continue
+            spec_name = _spec_param(top)
+            if spec_name is None:
+                continue
+            scan = _ClosureScan(spec_name)
+            # Walk only the builder's direct statements: array reads in
+            # the builder's own body run at trace-setup time on the
+            # host and are fine; only closure-captured reads bake
+            # constants.
+            for stmt in top.body:
+                scan.visit(stmt)
+            for node in scan.hits:
+                yield self.finding(
+                    src, node,
+                    f"`{spec_name}.{node.attr}` captured inside a "
+                    f"`{top.name}` program closure becomes a "
+                    f"mechanism-keyed XLA constant; bind traced "
+                    f"operands (`tspec = spec.bind(ops)`) and read "
+                    f"`tspec.{node.attr}`")

@@ -202,8 +202,8 @@ def all_checkers() -> list[Checker]:
     """Instances of every registered checker, rule-ID order. Imports
     the built-in checker modules on first use so plain
     ``import pycatkin_tpu.lint.core`` stays dependency-free."""
-    from . import (dtype, env_registry, fault_sites,  # noqa: F401
-                   host_sync, purity, tracer)
+    from . import (abi_capture, dtype, env_registry,  # noqa: F401
+                   fault_sites, host_sync, purity, tracer)
     return [_REGISTRY[rule]() for rule in sorted(_REGISTRY)]
 
 

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import engine
+from ..frontend import abi as _abi
 from ..frontend.spec import Conditions, ModelSpec
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
@@ -78,6 +79,7 @@ def clear_program_caches():
     engine._transient_chunk_program.cache_clear()
     engine._transient_finish_program.cache_clear()
     compile_pool.clear_registry()
+    _abi.clear_lowering_cache()
 
 
 # ---------------------------------------------------------------------
@@ -165,12 +167,36 @@ def _fused_enabled() -> bool:
         not in ("0", "off", "none", "disabled", "false")
 
 
+def _prog_spec(spec):
+    """The identity a program builder / the executable registry keys on:
+    the interned bucket object for an ABI-lowered spec (shared by every
+    mechanism in the bucket -- the whole point), the ModelSpec itself
+    otherwise."""
+    return spec.program_spec if isinstance(spec, _abi.AbiLowered) else spec
+
+
+def _prog_args(spec, args):
+    """Argument tuple a program is actually dispatched with: ABI
+    programs take the mechanism operand pytree as their leading traced
+    argument. Prewarm's direct program_key()/lower() paths and the
+    in-band dispatch MUST both go through this, or their keys drift."""
+    if isinstance(spec, _abi.AbiLowered):
+        return (spec.operands(),) + tuple(args)
+    return tuple(args)
+
+
 def _registered_call(spec: ModelSpec, kind: str, prog, args):
     """Run ``prog(*args)`` through a registered AOT executable when one
     matches (kind + argument shapes), else through the jitted program.
     A registered executable that refuses the arguments (shape/sharding
     drift vs what prewarm saw) is evicted and the call falls back --
-    correctness never depends on the registry."""
+    correctness never depends on the registry.
+
+    ``args`` is always the LEGACY argument tuple; the ABI operand
+    prepend (and the bucket registry identity) is applied here, in one
+    place, so no call site can desynchronize key and dispatch."""
+    args = _prog_args(spec, args)
+    spec = _prog_spec(spec)
     key = compile_pool.program_key(kind, args)
     exe = compile_pool.lookup(spec, key)
     if exe is not None:
@@ -201,6 +227,23 @@ def _donate_argnums(argnums):
 @lru_cache(maxsize=16)
 def _steady_program(spec: ModelSpec, opts: SolverOptions,
                     out_sharding=None, strategy: str = "ptc"):
+    if isinstance(spec, _abi.AbiProgramSpec):
+        # ABI form: the mechanism rides in as the leading traced operand
+        # pytree instead of being constant-folded, so every mechanism in
+        # the bucket shares this one executable. Operands are never
+        # donated -- the same buffers back every dispatch.
+        def program(ops, conds, keys, x0):
+            tspec = spec.bind(ops)
+
+            def solve_one(cond, key, x0):
+                return engine.steady_state(tspec, cond, x0=x0, key=key,
+                                           opts=opts, strategy=strategy)
+            return jax.vmap(solve_one)(conds, keys, x0)
+        kw = {"donate_argnums": _donate_argnums((2,))}
+        if out_sharding is not None:
+            kw["out_shardings"] = out_sharding
+        return jax.jit(program, **kw)
+
     def solve_one(cond, key, x0):
         return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
                                    strategy=strategy)
@@ -234,18 +277,35 @@ def _rescue_program(spec: ModelSpec, pacing: SolverOptions,
     ``pacing`` must be pre-normalized via :func:`_pacing_key` (the
     lru_cache would otherwise split per pacing value and resurrect the
     zoo this program exists to collapse)."""
-    def make(strategy):
+    def make(strategy, sp):
         def solve_one(cond, key, x0, seeded, dt0, grow, max_steps,
                       max_attempts):
             o = pacing._replace(dt0=dt0, dt_grow_min=grow,
                                 max_steps=max_steps,
                                 max_attempts=max_attempts)
-            return engine.steady_state(spec, cond, x0=x0, key=key,
+            return engine.steady_state(sp, cond, x0=x0, key=key,
                                        opts=o, strategy=strategy,
                                        use_x0=seeded)
         return jax.vmap(solve_one,
                         in_axes=(0, 0, 0) + (None,) * 5)
-    run_ptc, run_lm = make("ptc"), make("lm")
+
+    if isinstance(spec, _abi.AbiProgramSpec):
+        def program(ops, conds, keys, x0, strat, seeded, dt0, grow,
+                    max_steps, max_attempts):
+            # bind() once; the traced operands are closure-captured into
+            # both lax.cond branches (hoisted as implicit cond operands).
+            tspec = spec.bind(ops)
+            args = (conds, keys, x0, seeded, dt0, grow, max_steps,
+                    max_attempts)
+            return jax.lax.cond(strat == 1,
+                                lambda a: make("lm", tspec)(*a),
+                                lambda a: make("ptc", tspec)(*a), args)
+        kw = {"donate_argnums": _donate_argnums((2, 3))}
+        if out_sharding is not None:
+            kw["out_shardings"] = out_sharding
+        return jax.jit(program, **kw)
+
+    run_ptc, run_lm = make("ptc", spec), make("lm", spec)
 
     def program(conds, keys, x0, strat, seeded, dt0, grow, max_steps,
                 max_attempts):
@@ -263,6 +323,16 @@ def _rescue_program(spec: ModelSpec, pacing: SolverOptions,
 
 @lru_cache(maxsize=16)
 def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
+    if isinstance(spec, _abi.AbiProgramSpec):
+        def program(ops, conds, state, part):
+            tspec = spec.bind(ops)
+
+            def run_one(cond, st, p):
+                return engine.transient_state(tspec, cond, st, p, opts)
+            return jax.vmap(run_one, in_axes=(0, 0, None))(conds, state,
+                                                           part)
+        return jax.jit(program)
+
     def run_one(cond, state, part):
         return engine.transient_state(spec, cond, state, part, opts)
     return jax.jit(jax.vmap(run_one, in_axes=(0, 0, None)))
@@ -270,6 +340,16 @@ def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
 
 @lru_cache(maxsize=16)
 def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions):
+    if isinstance(spec, _abi.AbiProgramSpec):
+        def program(ops, conds, y_last, ok):
+            tspec = spec.bind(ops)
+
+            def fin_one(cond, y, o):
+                return engine.transient_finish(tspec, cond, y, o,
+                                               sopts=sopts)
+            return jax.vmap(fin_one)(conds, y_last, ok)
+        return jax.jit(program)
+
     def fin_one(cond, y_last, ok):
         return engine.transient_finish(spec, cond, y_last, ok, sopts=sopts)
     return jax.jit(jax.vmap(fin_one))
@@ -298,6 +378,17 @@ def _tof_program(spec: ModelSpec):
     cross-lane reduction counts negatives only over good lanes, so one
     quarantined/unconverged lane cannot poison (NaN) or inflate the
     aggregate while every per-lane output stays untouched."""
+    if isinstance(spec, _abi.AbiProgramSpec):
+        def batched(ops, conds, ys, mask, ok):
+            tspec = spec.bind(ops)
+            tofs = jax.vmap(lambda c, y: engine.tof(tspec, c, y,
+                                                    mask))(conds, ys)
+            act = engine.activity_from_tof(
+                tofs, jax.tree_util.tree_leaves(conds.T)[0])
+            lane_ok = ok & jnp.isfinite(tofs)
+            return tofs, act, jnp.sum(lane_ok & (tofs < 0.0))
+        return jax.jit(batched)
+
     def batched(conds, ys, mask, ok):
         tofs = jax.vmap(lambda c, y: engine.tof(spec, c, y, mask))(conds,
                                                                    ys)
@@ -353,6 +444,12 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     guesses. With a mesh, lanes are sharded across devices.
     Returns a lane-batched SteadyStateResults.
     """
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        out = batch_steady_state(low, low.pad_conditions(conds),
+                                 x0=low.pad_x0(x0), opts=opts, mesh=mesh)
+        return out._replace(x=low.unpad_y(jnp.asarray(out.x)))
+
     n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
 
     # Retry covers BOTH failure windows: the dispatch (this is the
@@ -365,7 +462,7 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     # rebuilt inside the retried closures: the solve program donates
     # its key buffer, so a retry must never re-feed a consumed array.
     if mesh is None:
-        prog = _steady_program(spec, opts)
+        prog = _steady_program(_prog_spec(spec), opts)
         kind = _steady_kind(opts, "ptc")
 
         def run_solve():
@@ -388,7 +485,7 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     conds_p = jax.device_put(conds_p, sharding)
     if x0_p is not None:
         x0_p = jax.device_put(x0_p, sharding)
-    prog_sh = _steady_program(spec, opts, sharding)
+    prog_sh = _steady_program(_prog_spec(spec), opts, sharding)
     # The mesh path consults the registry like every other dispatch:
     # program keys carry the per-argument sharding fingerprint
     # (compile_pool._shape_signature), so a serialized executable is
@@ -423,6 +520,12 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
     intervals for the slowest lane can run for minutes and trip
     execution watchdogs on shared TPU runtimes).
     Returns (ys [lanes, t, n_s], ok [lanes])."""
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        ys, ok = batch_transient(low, low.pad_conditions(conds), save_ts,
+                                 opts=opts, mesh=mesh, chunk=chunk)
+        return low.unpad_y(ys), ok
+
     n = None
     if mesh is not None:
         n_dev = mesh.devices.size
@@ -430,9 +533,17 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
         axis = mesh.axis_names[0]
         conds = jax.device_put(conds, NamedSharding(mesh, P(axis)))
 
+    cprog = _transient_chunk_program(_prog_spec(spec), opts)
+    fprog = _transient_finish_program(_prog_spec(spec),
+                                      engine.finish_options(opts))
+    if isinstance(spec, _abi.AbiLowered):
+        # The chunk driver calls the programs with legacy signatures;
+        # bake the operand pytree in as the leading argument here.
+        ops = spec.operands()
+        cprog, fprog = partial(cprog, ops), partial(fprog, ops)
+
     ys, ok = engine.chunked_transient_drive(
-        _transient_chunk_program(spec, opts),
-        _transient_finish_program(spec, engine.finish_options(opts)),
+        cprog, fprog,
         conds, jnp.asarray(conds.y0, dtype=jnp.float64), save_ts, opts,
         chunk, batched=True)
     if n is not None:
@@ -442,6 +553,16 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
 
 @lru_cache(maxsize=16)
 def _jacobian_program(spec: ModelSpec):
+    if isinstance(spec, _abi.AbiProgramSpec):
+        def program(ops, conds, ys):
+            tspec = spec.bind(ops)
+            dyn = tspec.dynamic_indices
+
+            def jac_one(cond, y):
+                return engine.steady_jacobian(tspec, cond, y[dyn])
+            return jax.vmap(jac_one)(conds, ys)
+        return jax.jit(program)
+
     dyn = jnp.asarray(spec.dynamic_indices)
 
     def jac_one(cond, y):
@@ -508,6 +629,46 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float,
                                   stability_tolerance_from_scale)
 
     eps_eff = effective_unit_roundoff(jnp.float64, backend)
+
+    if isinstance(spec, _abi.AbiProgramSpec):
+        # ABI form: the deflation basis is the traced lyap_q operand
+        # ([D, LYAP_PAD], real basis embedded + exact unit columns on
+        # pad slots), so the certificate shape is bucket-static. When a
+        # mechanism's deflated dimension cannot be represented (m == 0,
+        # m > LYAP_PAD, or too few pad slots) its lyap_ok operand is 0
+        # and the Lyapunov tier soundly abstains for every lane --
+        # those lanes fall through to tier 2 exactly like a
+        # Gershgorin-only legacy program.
+        def batched(ops, conds, ys, ok):
+            tspec = spec.bind(ops)
+            dyn = tspec.dynamic_indices
+            Q = tspec.lyap_q
+            lyap_ok = tspec.lyap_ok > 0
+
+            def screen_one(cond, y):
+                J = engine.steady_jacobian(tspec, cond, y[dyn])
+                absJ = jnp.abs(J)
+                diag = jnp.diag(J)
+                offrow = jnp.sum(absJ, axis=1) - jnp.abs(diag)
+                offcol = jnp.sum(absJ, axis=0) - jnp.abs(diag)
+                bound = jnp.minimum(jnp.max(diag + offrow),
+                                    jnp.max(diag + offcol))
+                scale = jnp.max(absJ)
+                finite = jnp.all(jnp.isfinite(J))
+                tol = stability_tolerance_from_scale(scale, pos_tol)
+                cert = finite & (bound <= tol)
+                cert = cert | (finite & lyap_ok & lyapunov_certified_stable(
+                    J, Q, tol, eps_eff=eps_eff))
+                return cert, finite
+
+            cert, finite = jax.vmap(screen_one)(conds, ys)
+            good = finite & ok
+            certified = good & cert
+            ambiguous = good & ~certified
+            return certified, ambiguous, jnp.sum(ambiguous)
+
+        return jax.jit(batched)
+
     dyn = jnp.asarray(spec.dynamic_indices)
     Q = deflation_basis_for_spec(spec)       # static per spec
     # m == 0 (all-conservation spectrum) has nothing to certify and
@@ -574,6 +735,85 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                                   lyapunov_certified_stable,
                                   packed_sweep_diagnostics,
                                   stability_tolerance_from_scale)
+
+    if isinstance(spec, _abi.AbiProgramSpec):
+        # ABI form: one fused executable per bucket; the mechanism is
+        # the leading traced operand pytree. Same output tuple, same
+        # tier-0 math -- the screen's deflation basis comes from the
+        # traced lyap_q/lyap_ok operands (see
+        # _stability_screen_program's ABI branch for the abstention
+        # semantics).
+        eps_eff = (effective_unit_roundoff(jnp.float64, backend)
+                   if check_stability else None)
+
+        def program(ops, conds, keys, x0, *tail_args):
+            tspec = spec.bind(ops)
+            dyn = tspec.dynamic_indices
+
+            def solve_one(cond, key, x0):
+                return engine.steady_state(tspec, cond, x0=x0, key=key,
+                                           opts=opts, strategy="ptc")
+
+            res = jax.vmap(solve_one)(conds, keys, x0)
+            finite_l = lane_finite_mask(res.x, res.residual)
+            succ_raw = jnp.asarray(res.success)
+            quar = succ_raw & ~finite_l
+            succ0 = succ_raw & finite_l
+            res = res._replace(success=succ0)
+            outs = [res, quar]
+            amb = demoted = None
+            ok_spec = succ0
+            if check_stability:
+                Q = tspec.lyap_q
+                lyap_ok = tspec.lyap_ok > 0
+
+                def screen_one(cond, y):
+                    J = engine.steady_jacobian(tspec, cond, y[dyn])
+                    absJ = jnp.abs(J)
+                    diag = jnp.diag(J)
+                    offrow = jnp.sum(absJ, axis=1) - jnp.abs(diag)
+                    offcol = jnp.sum(absJ, axis=0) - jnp.abs(diag)
+                    bound = jnp.minimum(jnp.max(diag + offrow),
+                                        jnp.max(diag + offcol))
+                    scale = jnp.max(absJ)
+                    finite = jnp.all(jnp.isfinite(J))
+                    tol = stability_tolerance_from_scale(scale, pos_tol)
+                    cert = finite & (bound <= tol)
+                    cert = cert | (finite & lyap_ok
+                                   & lyapunov_certified_stable(
+                                       J, Q, tol, eps_eff=eps_eff))
+                    return cert, finite
+
+                cert_raw, finite = jax.vmap(screen_one)(conds, res.x)
+                good = finite & succ0
+                cert = good & cert_raw
+                amb = good & ~cert
+                demoted = succ0 & ~cert
+                ok_spec = succ0 & cert
+                outs += [cert, amb]
+            n_neg = None
+            if has_tof:
+                mask = tail_args[0]
+                tofs = jax.vmap(
+                    lambda c, y: engine.tof(tspec, c, y, mask))(conds,
+                                                                res.x)
+                act = engine.activity_from_tof(
+                    tofs, jax.tree_util.tree_leaves(conds.T)[0])
+                neg = jnp.isfinite(tofs) & (tofs < 0.0)
+                lane_ok = ok_spec & jnp.isfinite(tofs)
+                n_neg = jnp.sum(lane_ok & (tofs < 0.0))
+                outs += [tofs, act, neg]
+            outs.append(packed_sweep_diagnostics(succ0, quar, amb,
+                                                 demoted, n_neg))
+            return tuple(outs)
+
+        kw = {"donate_argnums": _donate_argnums((2,))}
+        if out_sharding is not None:
+            n_lane_outs = 2 + (2 if check_stability else 0) \
+                + (3 if has_tof else 0)
+            repl = NamedSharding(out_sharding.mesh, P())
+            kw["out_shardings"] = (out_sharding,) * n_lane_outs + (repl,)
+        return jax.jit(program, **kw)
 
     dyn = jnp.asarray(spec.dynamic_indices)
 
@@ -753,7 +993,8 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
             # retrying only the dispatch would not re-run the program.
             cert, amb, n_amb_dev = _registered_call(
                 spec, _screen_kind(pos_tol, backend),
-                _stability_screen_program(spec, pos_tol, backend),
+                _stability_screen_program(_prog_spec(spec), pos_tol,
+                                          backend),
                 (conds, ys, ok_dev))
             # scalar round trip
             return cert, amb, int(host_sync(n_amb_dev,
@@ -797,7 +1038,8 @@ def _stability_tier2(spec: ModelSpec, conds: Conditions, ys,
     # double the payload).
     def run_jac():
         return host_sync(
-            _registered_call(spec, "jac", _jacobian_program(spec),
+            _registered_call(spec, "jac",
+                             _jacobian_program(_prog_spec(spec)),
                              (sub, ys_p))[:len(idx)],
             "tier-2 jacobian")
 
@@ -933,7 +1175,7 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     x_dtype = jnp.asarray(res.x).dtype
     sub = _place_subset(mesh, len(idx_p), sub)
     bsh = _subset_sharding(mesh, len(idx_p))
-    prog = _rescue_program(spec, _pacing_key(opts), bsh)
+    prog = _rescue_program(_prog_spec(spec), _pacing_key(opts), bsh)
     kind = _rescue_kind(opts, bsh)
     # The pacing/strategy scalars are ()-shaped TRACED arguments --
     # their VALUES never enter the program key, so every ladder rung
@@ -1036,6 +1278,21 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     warning always fires host-side on the materialized TOF vector, and
     out['tof'] carries the signs.
     """
+    # ABI gate: lower the mechanism into its shape bucket and run the
+    # WHOLE sweep (fused or legacy tail, sharded or not) on the padded
+    # system -- every downstream program then keys on the bucket, not
+    # the mechanism. Only the public 'y' needs unpadding; the per-lane
+    # masks/diagnostics are lane-shaped and pass through unchanged.
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        out = sweep_steady_state(low, low.pad_conditions(conds),
+                                 tof_mask=low.pad_tof_mask(tof_mask),
+                                 x0=low.pad_x0(x0), opts=opts, mesh=mesh,
+                                 check_stability=check_stability,
+                                 pos_jac_tol=pos_jac_tol)
+        out["y"] = low.unpad_y(jnp.asarray(out["y"]))
+        return out
+
     # Two-phase solve: a capped single-attempt first pass (sized for the
     # ~p99 lane), then host-side rescue of the failed subset with the
     # full retry ladder, then the LM strategy fallback. Stragglers no
@@ -1124,8 +1381,8 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     fast = _fast_pass_opts(opts)
     has_tof = tof_mask is not None
     sh = _subset_sharding(mesh, n_lanes)
-    prog = _fused_sweep_program(spec, fast, pos_jac_tol, backend,
-                                has_tof, check_stability, sh)
+    prog = _fused_sweep_program(_prog_spec(spec), fast, pos_jac_tol,
+                                backend, has_tof, check_stability, sh)
     kind = _fused_kind(fast, pos_jac_tol, backend, has_tof,
                        check_stability, sh)
     mask_arr = jnp.asarray(tof_mask) if has_tof else None
@@ -1271,7 +1528,8 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         if check_stability:
             cert, amb, n_amb_dev = _registered_call(
                 spec, _screen_kind(pos_jac_tol, backend),
-                _stability_screen_program(spec, pos_jac_tol, backend),
+                _stability_screen_program(_prog_spec(spec), pos_jac_tol,
+                                          backend),
                 (conds, res.x, succ0))
             ok_spec = succ0 & cert
             if sh_full is not None:
@@ -1283,7 +1541,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         tofs = act = n_neg_dev = None
         if tof_mask is not None:
             tofs, act, n_neg_dev = _registered_call(
-                spec, "tof", _tof_program(spec),
+                spec, "tof", _tof_program(_prog_spec(spec)),
                 (conds, res.x, mask_arr, ok_spec))
         bundle = _tail_bundle(succ0, quar, amb, demoted, n_neg_dev)
         return (cert, amb, n_amb_dev, tofs, act,
@@ -1399,7 +1657,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
                                          jnp.asarray(stable))
     if tof_mask is not None:
-        tprog = _tof_program(spec)
+        tprog = _tof_program(_prog_spec(spec))
         ok_arr = jnp.asarray(out["success"])
         if sh_full is not None:
             ok_arr = jax.device_put(ok_arr, sh_full)
@@ -1450,6 +1708,16 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     that still fails lands in the ordinary rescue ladder). Returns the
     same dict as :func:`sweep_steady_state`, in original lane order.
     """
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        out = continuation_sweep(low, low.pad_conditions(conds), order,
+                                 tof_mask=low.pad_tof_mask(tof_mask),
+                                 opts=opts, stage_opts=stage_opts,
+                                 check_stability=check_stability,
+                                 pos_jac_tol=pos_jac_tol)
+        out["y"] = low.unpad_y(jnp.asarray(out["y"]))
+        return out
+
     order = np.asarray(order)  # sync-ok: host-built index plan, not device data
     n_stages, m = order.shape
     n_lanes = len(jax.tree_util.tree_leaves(conds)[0])
@@ -1482,15 +1750,20 @@ def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
     # scalar check; callers needing full execution-retry coverage can
     # re-invoke (the sweep is pure).
     stage_res = [None] * n_stages
-    first_prog = _steady_program(spec, first)
+    # Direct program dispatch (no registry): the ABI operand prepend is
+    # applied explicitly via _prog_args on each stage call.
+    first_prog = _steady_program(_prog_spec(spec), first)
     stage_res[0] = call_with_backend_retry(
-        lambda: first_prog(subs[0], stage_keys(0), None),
+        lambda: first_prog(*_prog_args(spec,
+                                       (subs[0], stage_keys(0), None))),
         label="continuation stage 0")
-    prog = _steady_program(spec, cont)
+    prog = _steady_program(_prog_spec(spec), cont)
     for s in range(1, n_stages):
         x0 = stage_res[s - 1].x[:, dyn]
         stage_res[s] = call_with_backend_retry(
-            lambda s=s, x0=x0: prog(subs[s], stage_keys(s), x0),
+            lambda s=s, x0=x0: prog(*_prog_args(spec,
+                                                (subs[s], stage_keys(s),
+                                                 x0))),
             label=f"continuation stage {s}")
 
     # Reassemble into original lane order (pure device ops).
@@ -1654,6 +1927,21 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     Every compile/load/execute rides the transient-error retry, so a
     flake can never escape to the caller's timed region.
     """
+    # ABI gate: prewarm against the lowered/padded system -- the zoo
+    # then keys on the shape bucket, so a SECOND mechanism landing in
+    # the same bucket resolves every program from the registry with
+    # zero compiles (asserted by bench.py --smoke).
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        return prewarm_sweep_programs(
+            low, low.pad_conditions(conds),
+            tof_mask=low.pad_tof_mask(tof_mask), opts=opts,
+            buckets=buckets, aot_buckets=aot_buckets,
+            tier2_buckets=tier2_buckets,
+            tier2_aot_buckets=tier2_aot_buckets,
+            check_stability=check_stability, pos_jac_tol=pos_jac_tol,
+            verbose=verbose, cache=cache, workers=workers, mesh=mesh)
+
     import time as _time
 
     def _log(msg):
@@ -1675,11 +1963,17 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     _log(f"AOT cache: {cache.root or 'disabled'}; "
          f"compile pool width {workers or compile_pool.compile_workers()}")
 
+    # Registry identity: the shape bucket under ABI, the spec itself
+    # otherwise (must match what _registered_call consults at sweep
+    # time). Job "args" carry the ABI operand prepend so program_key()
+    # and lower() see the dispatch-time signature.
+    pspec = _prog_spec(spec)
+
     def _resolve(kind, prog, args, label):
         """Registry/cache lookup for one program; returns True when an
         executable is already available (registered now or before)."""
         key = compile_pool.program_key(kind, args)
-        if compile_pool.lookup(spec, key) is not None:
+        if compile_pool.lookup(pspec, key) is not None:
             return key, True
         try:
             exe = cache.load(key)
@@ -1687,7 +1981,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             _log(f"{label}: stale AOT entry ({e}); recompiling")
             exe = None
         if exe is not None:
-            compile_pool.register(spec, key, exe)
+            compile_pool.register(pspec, key, exe)
             _log(f"{label}: loaded from AOT cache")
             return key, True
         return key, False
@@ -1703,7 +1997,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         cache.save(job["key"], exe,
                    sharding=compile_pool.args_sharding_fingerprint(
                        job["args"]))
-        compile_pool.register(spec, job["key"], exe)
+        compile_pool.register(pspec, job["key"], exe)
         return exe
 
     n_compiled = 0
@@ -1761,11 +2055,12 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     tail = (mask_arr,) if has_tof else ()
     fast_kind = _fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
                             check_stability, sharding)
-    fast_prog = _fused_sweep_program(spec, fast_opts, pos_jac_tol,
+    fast_prog = _fused_sweep_program(pspec, fast_opts, pos_jac_tol,
                                      backend, has_tof, check_stability,
                                      sharding)
     fast_job = {"kind": fast_kind, "prog": fast_prog,
-                "args": (conds, _keys_full(), None) + tail,
+                "args": _prog_args(spec,
+                                   (conds, _keys_full(), None) + tail),
                 "label": f"fused sweep @{n}"}
     _ensure([fast_job])
 
@@ -1774,7 +2069,8 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     # fast pass below. ys-dependent arguments enter the jobs as
     # jax.ShapeDtypeStruct (lower() and program_key() only consume
     # shape/dtype/sharding); phase C builds the concrete arrays. ---
-    shapes = jax.eval_shape(fast_prog, conds, _keys_full(), None, *tail)
+    shapes = jax.eval_shape(
+        fast_prog, *_prog_args(spec, (conds, _keys_full(), None) + tail))
     x_dtype = shapes[0].x.dtype
     n_species = shapes[0].x.shape[1]
 
@@ -1788,7 +2084,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
 
     def _add(kind, prog, args, label, execute, fence, exec_args=None):
         # Dedup on the program key: e.g. the same jac bucket named in
-        # both `tier2_buckets` and `tier2_aot_buckets` once.
+        # both `tier2_buckets` and `tier2_aot_buckets` once. The ABI
+        # operand prepend is baked into job args here (so key/lower
+        # match dispatch); exec_args stay legacy -- phase C dispatches
+        # through _registered_call, which prepends internally.
+        args = _prog_args(spec, args)
         key = compile_pool.program_key(kind, args)
         if key in seen_keys:
             return
@@ -1832,7 +2132,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             return (sub, keys, x0) + scal
 
         _add(_rescue_kind(opts, bsh),
-             _rescue_program(spec, _pacing_key(opts), bsh),
+             _rescue_program(pspec, _pacing_key(opts), bsh),
              (sub, keys_b, _sds((b, int(dyn.size)), x_dtype, bsh))
              + scal,
              f"{tag}rescue @{b}", execute, solve_fence, exec_args)
@@ -1848,7 +2148,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
                 ysub = _place_subset(mesh, b, ysub)
             return (sub, ysub)
 
-        _add("jac", _jacobian_program(spec),
+        _add("jac", _jacobian_program(pspec),
              (sub, _sds((b, n_species), x_dtype, bsh)),
              f"{tag}tier-2 jac @{b}", execute, jac_fence, exec_args)
 
@@ -1905,9 +2205,15 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
             continue
 
         def run(j=job):
-            args = (j["exec_args"](res) if j["exec_args"] is not None
-                    else j["args"])
-            out = _registered_call(spec, j["kind"], j["prog"], args)
+            # exec_args are LEGACY args (_registered_call prepends the
+            # ABI operands); the stored job args already carry them, so
+            # that fallback dispatches against the bucket identity.
+            if j["exec_args"] is not None:
+                out = _registered_call(spec, j["kind"], j["prog"],
+                                       j["exec_args"](res))
+            else:
+                out = _registered_call(pspec, j["kind"], j["prog"],
+                                       j["args"])
             np.asarray(j["fence"](out))      # sync inside the retry
             return out
 
@@ -1943,11 +2249,20 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
     The whole clean sweep is ONE fused program now
     (:func:`_fused_sweep_program`), so one registry entry covers the
     worker's entire happy path."""
+    low = _abi.maybe_lower(spec)
+    if low is not None:
+        return warm_from_aot_cache(
+            low, low.pad_conditions(conds),
+            tof_mask=low.pad_tof_mask(tof_mask), opts=opts,
+            check_stability=check_stability, pos_jac_tol=pos_jac_tol,
+            cache=cache)
+
     if cache is None:
         cache = compile_pool.AOTCache(
             fingerprint=compile_pool.spec_fingerprint(spec))
     if not cache.enabled:
         return 0
+    pspec = _prog_spec(spec)
     n = jax.tree_util.tree_leaves(conds)[0].shape[0]
     keys = jax.random.split(jax.random.PRNGKey(0), n)
     fast_opts = _fast_pass_opts(opts)
@@ -1956,20 +2271,20 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
     tail = (jnp.asarray(tof_mask),) if has_tof else ()
     jobs = [(_fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
                          check_stability),
-             _fused_sweep_program(spec, fast_opts, pos_jac_tol, backend,
+             _fused_sweep_program(pspec, fast_opts, pos_jac_tol, backend,
                                   has_tof, check_stability),
-             (conds, keys, None) + tail)]
+             _prog_args(spec, (conds, keys, None) + tail))]
     n_loaded = 0
     for kind, _prog, args in jobs:
         key = compile_pool.program_key(kind, args)
-        if compile_pool.lookup(spec, key) is not None:
+        if compile_pool.lookup(pspec, key) is not None:
             continue
         try:
             exe = cache.load(key)
         except compile_pool.CacheMismatch:
             continue                       # cannot recompile here
         if exe is not None:
-            compile_pool.register(spec, key, exe)
+            compile_pool.register(pspec, key, exe)
             n_loaded += 1
     return n_loaded
 

@@ -84,8 +84,18 @@ def spec_fingerprint(spec) -> str:
     """Content hash of a ModelSpec (field name + dtype/shape/bytes of
     every array field, repr of the rest) -- the identity a cached
     executable is bound to. ModelSpec itself hashes by object identity
-    (it keys jit caches), so this is the cross-process stand-in."""
+    (it keys jit caches), so this is the cross-process stand-in.
+
+    ABI specs (frontend/abi.py AbiProgramSpec / AbiLowered) are the
+    exception: their cache identity is deliberately the *bucket*, not
+    the mechanism -- ``abi-v<version>:s<S>:r<R>:d<D>:...`` -- so one
+    cache entry (and one exported AOT pack) serves every mechanism that
+    lowers into the bucket."""
     import dataclasses
+
+    abi_fp = getattr(spec, "abi_fingerprint", None)
+    if abi_fp is not None:
+        return str(abi_fp)
 
     h = hashlib.sha256()
     if dataclasses.is_dataclass(spec):
@@ -331,6 +341,11 @@ class AOTCache:
                      "payload": payload,
                      "in_tree": in_tree,
                      "out_tree": out_tree}
+            # Bucket-keyed (ABI) entries additionally record their
+            # abi_version + bucket shape: the entry serves EVERY
+            # mechanism in the bucket, and pack consumers audit that
+            # claim from the manifest without parsing fingerprints.
+            entry.update(abi_entry_fields(self.fingerprint))
             blob = pickle.dumps(entry)
             os.makedirs(self.root, exist_ok=True)
             tmp = self._path(key) + f".tmp.{os.getpid()}"
@@ -436,18 +451,39 @@ def map_compile(tasks, workers: int | None = None):
 PACK_MANIFEST = "manifest.json"
 
 
+def abi_entry_fields(fingerprint: str) -> dict:
+    """ABI provenance recorded on cache entries whose spec fingerprint
+    is a bucket identity (``abi-v<ver>:s<S>:r<R>:d<D>:...``, see
+    frontend/abi.py): the abi_version and the bucket shape, split out
+    so pack consumers can audit cross-mechanism compatibility without
+    parsing the fingerprint. Empty for legacy per-mechanism entries."""
+    fp = str(fingerprint)
+    if not fp.startswith("abi-v"):
+        return {}
+    head, _, bucket = fp.partition(":")
+    try:
+        version = int(head[len("abi-v"):])
+    except ValueError:
+        return {}
+    return {"abi_version": version, "abi_bucket": bucket}
+
+
 def _entry_meta(path: str) -> dict:
     """Validity metadata of one on-disk cache entry (unpickles the
     entry dict but never deserializes the executable payload)."""
     with open(path, "rb") as fh:
         entry = pickle.load(fh)
-    return {"fingerprint": entry.get("fingerprint"),
+    meta = {"fingerprint": entry.get("fingerprint"),
             "jax": entry.get("jax"),
             "backend": entry.get("backend"),
             "device_kind": entry.get("device_kind"),
             "sharding": entry.get("sharding", ""),
             "devices": entry.get("devices"),
             "size": os.path.getsize(path)}
+    for k in ("abi_version", "abi_bucket"):
+        if k in entry:
+            meta[k] = entry[k]
+    return meta
 
 
 def export_cache_pack(pack_path: str, cache_root: str | None = None) -> dict:

@@ -1,0 +1,43 @@
+"""PCL007 fixture: a ``*_program`` builder whose jitted closure reads
+``spec.<array>`` numpy fields -- the constant-folding idiom the
+mechanism ABI (frontend/abi.py) removes from the hot builders. Legal
+reads are seeded too: array reads in the builder's trace-setup body,
+scalar statics inside the closure, and a shadowing inner ``spec``.
+Never executed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _steady_program(spec, engine):
+    x0 = jnp.zeros(spec.dynamic_indices.shape)   # OK: builder body
+
+    def program(conds, keys):
+        S = spec.stoich                          # VIOLATION PCL007
+        nu = spec.reac_idx  # pclint: disable=PCL007 -- fixture: reviewed legacy constant
+        n = spec.n_species                       # OK: scalar static
+        rates = jax.vmap(lambda c: engine.rhs(spec, c, x0))(conds)
+        return S @ rates.T, nu, n, keys
+
+    return jax.jit(program)
+
+
+def _tof_program(spec, engine):
+    def batched(conds, ys):
+        mask = jnp.asarray(spec.is_ghost)        # VIOLATION PCL007
+
+        def inner(spec):                         # shadows the builder's
+            return spec.stoich                   # OK: not ours
+
+        per_lane = jax.vmap(lambda c, y: spec.area * y)(conds, ys)
+        # ^ VIOLATION PCL007 (lambda closure)
+        return mask, inner, per_lane
+
+    return jax.jit(batched)
+
+
+def helper_not_a_builder(spec):
+    def program(conds):
+        return spec.stoich @ conds               # OK: not a *_program
+    return program

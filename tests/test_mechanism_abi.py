@@ -1,0 +1,272 @@
+"""Mechanism ABI: shape-bucketed traced-operand specs (PYCATKIN_ABI=1).
+
+The ABI inverts the program zoo's identity: mechanism arrays ride into
+every program as a leading traced operand pytree, zero-padded into a
+static shape bucket, so ONE compiled executable serves every mechanism
+that lands in the bucket. These tests pin the three contracts that make
+the inversion safe:
+
+1.  EQUIVALENCE -- the padded traced path computes the same physics as
+    the legacy constant-folded path. The padding semantics are exact
+    (rate constants are bitwise identical; pad reactions produce
+    exactly-zero rates), and every verdict/count output of a sweep is
+    bitwise identical. Continuous outputs are compared under a tight
+    tolerance instead of bytes: XLA:CPU's GEMM K-blocking reassociates
+    zero-padded contraction dimensions (measurable on a plain
+    ``A @ B`` with padded K), which perturbs the jacfwd matmats inside
+    Newton at the last-ulp level. See docs/mechanism_abi.md
+    ("Bit-identity envelope") for the measured envelope.
+
+2.  SHARING -- two different mechanisms in one bucket intern the SAME
+    program-spec object and fingerprint, so the second one prewarns
+    with zero fresh compiles.
+
+3.  DIAGNOSTICS -- a mechanism that cannot fit any bucket raises an
+    AbiBucketError carrying a ValidationReport, and the batch-layer
+    gate falls back to the legacy path with a single warning.
+"""
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.frontend.validate import ValidationReport
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import compile_pool
+from pycatkin_tpu.parallel.batch import (batch_transient,
+                                         broadcast_conditions,
+                                         clear_program_caches,
+                                         prewarm_sweep_programs,
+                                         sweep_steady_state)
+from pycatkin_tpu.robustness.faults import FaultPlan, FaultSpec, fault_scope
+from pycatkin_tpu.solvers.ode import ODEOptions
+
+N_LANES = 32
+
+# Outputs that must match BITWISE between the legacy and ABI paths:
+# every verdict, count and diagnostic integer/bool lane array.
+_FLOAT_TOL = dict(rtol=1e-4, atol=1e-8)
+
+
+def _problem(n_species=16, n_reactions=24, seed=3, n=N_LANES):
+    sim = synthetic_system(n_species=n_species, n_reactions=n_reactions,
+                           seed=seed)
+    spec = sim.spec
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(480.0, 620.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask, sim.solver_options()
+
+
+def _assert_equivalent(ref: dict, out: dict, loose_lanes=()):
+    """Verdicts/counts bitwise; floats to _FLOAT_TOL -- except on
+    ``loose_lanes`` (fault-injected, rescued lanes), where both paths
+    re-converge from *different* perturbed iterates and only agree to
+    the solver's own tolerance, not component-wise to 1e-4."""
+    assert sorted(ref.keys()) == sorted(out.keys())
+    loose = np.zeros(0, dtype=bool)
+    for k in sorted(ref.keys()):
+        a, b = np.asarray(ref[k]), np.asarray(out[k])
+        assert a.shape == b.shape, f"{k}: {a.shape} vs {b.shape}"
+        assert a.dtype == b.dtype, k
+        if a.dtype.kind in "biu":
+            assert a.tobytes() == b.tobytes(), (
+                f"verdict/count output {k!r} differs between the legacy "
+                f"and ABI paths")
+            continue
+        if loose_lanes and a.ndim >= 1:
+            if loose.shape != (a.shape[0],):
+                loose = np.zeros(a.shape[0], dtype=bool)
+                loose[list(loose_lanes)] = True
+            np.testing.assert_allclose(b[~loose], a[~loose], err_msg=k,
+                                       **_FLOAT_TOL)
+            np.testing.assert_allclose(b[loose], a[loose], err_msg=k,
+                                       rtol=5e-2, atol=1e-6)
+        else:
+            np.testing.assert_allclose(b, a, err_msg=k, **_FLOAT_TOL)
+
+
+@pytest.fixture()
+def abi_on(monkeypatch):
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    clear_program_caches()
+    yield
+    monkeypatch.delenv(abi.ABI_ENV, raising=False)
+    clear_program_caches()
+
+
+# ---------------------------------------------------------------------------
+# 1. equivalence
+
+
+def test_operand_padding_is_exact():
+    """Rate constants through the bound TracedSpec are BITWISE those of
+    the legacy spec on real slots, and exactly zero on pad reactions --
+    the padding rules are no-ops, not approximations."""
+    import jax
+
+    spec, conds, _, _ = _problem()
+    low = abi.lower_spec(spec)
+    tspec = low.program_spec.bind(low.operands())
+    cond = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], conds)
+    pcond = low.pad_conditions(cond)
+    n_r = len(spec.rnames)
+
+    ref = jax.jit(lambda c: engine.rate_constants(spec, c))(cond)
+    got = jax.jit(lambda c: engine.rate_constants(tspec, c))(pcond)
+    kf, kr = np.asarray(got[0]), np.asarray(got[1])
+    assert np.asarray(ref[0]).tobytes() == kf[:n_r].tobytes()
+    assert np.asarray(ref[1]).tobytes() == kr[:n_r].tobytes()
+    # Ghost pad reactions carry EXACTLY zero rates in both directions.
+    assert np.all(kf[n_r:] == 0.0) and np.all(kr[n_r:] == 0.0)
+    assert np.all(np.isfinite(np.asarray(got[2])))
+
+
+@pytest.mark.parametrize("dims", [(16, 24), (24, 32)],
+                         ids=["padded-small", "synthetic"])
+def test_sweep_equivalence_clean(dims, abi_on, monkeypatch):
+    n_s, n_r = dims
+    spec, conds, mask, opts = _problem(n_s, n_r)
+
+    monkeypatch.delenv(abi.ABI_ENV, raising=False)
+    ref = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                             check_stability=True)
+    clear_program_caches()
+
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                             check_stability=True)
+    # The gate restored the public composition width.
+    assert np.asarray(out["y"]).shape == np.asarray(ref["y"]).shape
+    _assert_equivalent(ref, out)
+
+
+def test_sweep_equivalence_quarantine_and_rescue(abi_on, monkeypatch):
+    """Fault-injected corpus: a NaN-poisoned solve lane forces the
+    quarantine demotion + rescue ladder; the ABI path must walk the
+    same ladder to the same verdicts."""
+    spec, conds, mask, opts = _problem()
+    plan = FaultPlan([FaultSpec(site="batched steady solve", kind="nan",
+                                lanes=(7,), times=1)])
+
+    monkeypatch.delenv(abi.ABI_ENV, raising=False)
+    with fault_scope(plan):
+        ref = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                                 check_stability=True)
+    clear_program_caches()
+
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    plan2 = FaultPlan([FaultSpec(site="batched steady solve", kind="nan",
+                                 lanes=(7,), times=1)])
+    with fault_scope(plan2):
+        out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                                 check_stability=True)
+    _assert_equivalent(ref, out, loose_lanes=(7,))
+
+
+def test_batch_transient_equivalence(abi_on, monkeypatch):
+    spec, conds, _, _ = _problem(n=8)
+    save_ts = np.array([0.0, 1e-6, 1e-3, 1.0])
+    opts = ODEOptions()
+
+    monkeypatch.delenv(abi.ABI_ENV, raising=False)
+    ys_ref, ok_ref = batch_transient(spec, conds, save_ts, opts=opts)
+    clear_program_caches()
+
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    ys, ok = batch_transient(spec, conds, save_ts, opts=opts)
+    assert np.asarray(ys).shape == np.asarray(ys_ref).shape
+    assert np.asarray(ok).tobytes() == np.asarray(ok_ref).tobytes()
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ys_ref),
+                               **_FLOAT_TOL)
+
+
+# ---------------------------------------------------------------------------
+# 2. bucket sharing
+
+
+def test_two_mechanisms_share_one_bucket(abi_on):
+    """Different mechanisms, same bucket: interned program spec and
+    cache identity are THE SAME OBJECT, and prewarming the second
+    mechanism after the first performs zero fresh compiles."""
+    sA, cA, mA, oA = _problem(16, 24, seed=3, n=16)
+    sB, cB, mB, oB = _problem(17, 24, seed=7, n=16)
+
+    lowA, lowB = abi.lower_spec(sA), abi.lower_spec(sB)
+    assert lowA.program_spec is lowB.program_spec
+    assert (compile_pool.spec_fingerprint(lowA)
+            == compile_pool.spec_fingerprint(lowB))
+    assert lowA.abi_fingerprint.startswith(f"abi-v{abi.ABI_VERSION}:")
+
+    stats_a = prewarm_sweep_programs(sA, cA, tof_mask=mA, opts=oA,
+                                     buckets=(), check_stability=True,
+                                     cache=False)
+    assert stats_a.compiled > 0
+    stats_b = prewarm_sweep_programs(sB, cB, tof_mask=mB, opts=oB,
+                                     buckets=(), check_stability=True,
+                                     cache=False)
+    assert stats_b.compiled == 0, (
+        "second mechanism in a warm bucket must trigger ZERO compiles")
+    assert int(stats_b) == int(stats_a)
+
+    # And the warm zoo actually solves mechanism B.
+    out = sweep_steady_state(sB, cB, tof_mask=mB, opts=oB,
+                             check_stability=True)
+    assert bool(np.all(np.asarray(out["success"])))
+
+
+# ---------------------------------------------------------------------------
+# 3. diagnostics / gating
+
+
+def test_out_of_bucket_raises_validation_report():
+    spec, _, _, _ = _problem()
+    with pytest.raises(abi.AbiBucketError) as exc:
+        spec.to_abi(species_bucket=16, reaction_bucket=16)
+    err = exc.value
+    assert isinstance(err.report, ValidationReport)
+    assert err.report.errors
+    locs = {i.location for i in err.report.errors}
+    assert "/abi/species" in locs and "/abi/reactions" in locs
+    assert "does not fit" in str(err)
+
+
+def test_unfittable_mechanism_falls_back_with_warning(abi_on, monkeypatch):
+    spec, conds, mask, opts = _problem(n=8)
+    monkeypatch.setattr(abi, "SPECIES_BUCKETS", (4,))
+    monkeypatch.setattr(abi, "_FALLBACK_WARNED", set())
+    abi.clear_lowering_cache()
+    with pytest.warns(UserWarning, match="does not fit any ABI bucket"):
+        assert abi.maybe_lower(spec) is None
+    # Second call is silent (warn once per spec) and the sweep still
+    # solves through the legacy constant-folded path.
+    assert abi.maybe_lower(spec) is None
+    out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts)
+    assert bool(np.all(np.asarray(out["success"])))
+    abi.clear_lowering_cache()
+
+
+def test_abi_off_means_no_lowering(monkeypatch):
+    monkeypatch.delenv(abi.ABI_ENV, raising=False)
+    spec, _, _, _ = _problem()
+    assert abi.maybe_lower(spec) is None
+
+
+def test_bucket_boundary_headroom_warning():
+    from types import SimpleNamespace
+
+    from pycatkin_tpu.frontend.validate import check_abi_headroom
+
+    # Comfortably inside its bucket: clean report.
+    spec, _, _, _ = _problem()
+    assert not check_abi_headroom(spec).warnings
+    # 124 species + the pad slot = 125 > 0.95 * 128: hugging the edge.
+    near = SimpleNamespace(n_species=124, n_reactions=40)
+    report = check_abi_headroom(near)
+    assert [i.location for i in report.warnings] == ["/abi/species"]
+    assert "128" in report.warnings[0].message
+    # Both dims at the edge warn independently.
+    near2 = SimpleNamespace(n_species=124, n_reactions=63)
+    assert {i.location for i in check_abi_headroom(near2).warnings} == {
+        "/abi/species", "/abi/reactions"}

@@ -25,6 +25,8 @@ import pytest
 
 from pycatkin_tpu.lint import baseline
 from pycatkin_tpu.lint import core
+from pycatkin_tpu.lint.abi_capture import (SPEC_ARRAY_FIELDS,
+                                           AbiCaptureChecker)
 from pycatkin_tpu.lint.core import Finding, checkers_for, lint_file, run_lint
 from pycatkin_tpu.lint.dtype import DtypeChecker
 from pycatkin_tpu.lint.env_registry import EnvRegistryChecker
@@ -202,6 +204,38 @@ def test_env_registry_documents_production_knobs():
         assert ("PYCATKIN_" + k) in keys
 
 
+# ---------------------------------------------------------------- PCL007
+
+def test_abi_capture_fixture():
+    findings = lint_file(AbiCaptureChecker(), fx("abi_capture_legacy.py"))
+    act = active(findings)
+    # stoich + is_ghost + the vmapped lambda's spec.area capture; the
+    # builder-body read, scalar statics, the shadowed inner spec and
+    # the non-builder helper all stay clean.
+    assert len(act) == 3
+    assert {("spec." + f.message.split("`")[1].split(".")[-1])
+            for f in act} == {"spec.stoich", "spec.is_ghost", "spec.area"}
+    assert len(inline(findings)) == 1
+    assert "spec.bind(ops)" in act[0].message
+
+
+def test_abi_capture_field_list_matches_modelspec():
+    """SPEC_ARRAY_FIELDS (a literal -- the linter imports no package
+    code) must be exactly ModelSpec's numpy-array fields, so a new
+    array field cannot silently escape the rule."""
+    import dataclasses
+
+    import numpy as np
+
+    from pycatkin_tpu.frontend.spec import ModelSpec
+    from pycatkin_tpu.models.synthetic import synthetic_system
+
+    spec = synthetic_system(n_species=6, n_reactions=8).spec
+    array_fields = {f.name for f in dataclasses.fields(ModelSpec)
+                    if isinstance(getattr(spec, f.name), np.ndarray)}
+    assert SPEC_ARRAY_FIELDS == array_fields
+
+
 # ------------------------------------------------- suppression machinery
 
 _FIXTURE_MATRIX = [
@@ -211,6 +245,7 @@ _FIXTURE_MATRIX = [
     ("PCL004", lambda tmp: TracerLeakChecker(), "batch_legacy.py"),
     ("PCL005", lambda tmp: DtypeChecker(), "dtype_legacy.py"),
     ("PCL006", lambda tmp: EnvRegistryChecker(), "env_legacy.py"),
+    ("PCL007", lambda tmp: AbiCaptureChecker(), "abi_capture_legacy.py"),
 ]
 
 

@@ -120,6 +120,100 @@ def _cmd_selftest(args) -> int:
     print(f"selftest: OK -- {exported['entries']} entries round-tripped, "
           f"{stats_b.loaded} loaded / 0 compiled from pack, sweep "
           "bit-identical")
+    return _selftest_abi_cross_mechanism()
+
+
+def _selftest_abi_cross_mechanism() -> int:
+    """Phase 2: the ABI promise. With PYCATKIN_ABI=1 cache entries are
+    keyed on the shape BUCKET, so a pack exported after warming
+    mechanism A must warm a DIFFERENT mechanism B in the same bucket
+    with zero compiles, and the manifest must record each entry's
+    abi_version + bucket shape."""
+    import tarfile
+    import tempfile
+
+    import numpy as np
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.frontend import abi
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel import compile_pool
+    from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                             clear_program_caches,
+                                             prewarm_sweep_programs,
+                                             sweep_steady_state)
+
+    def problem(n_species, seed):
+        sim = synthetic_system(n_species=n_species, n_reactions=24,
+                               seed=seed)
+        spec = sim.spec
+        conds = broadcast_conditions(sim.conditions(), 32)
+        conds = conds._replace(T=np.linspace(420.0, 780.0, 32))
+        mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+        return spec, conds, mask
+
+    prev = os.environ.get(abi.ABI_ENV)
+    os.environ[abi.ABI_ENV] = "1"
+    try:
+        clear_program_caches()
+        sA, cA, mA = problem(16, seed=3)
+        sB, cB, mB = problem(17, seed=7)   # same bucket, different mech
+        fpA = compile_pool.spec_fingerprint(abi.lower_spec(sA))
+        fpB = compile_pool.spec_fingerprint(abi.lower_spec(sB))
+        if fpA != fpB:
+            print(f"selftest: FAIL -- A/B land in different buckets "
+                  f"({fpA} vs {fpB})")
+            return 1
+        layout = dict(buckets=(8,), check_stability=True)
+
+        with tempfile.TemporaryDirectory() as tmp:
+            root_a = os.path.join(tmp, "a")
+            root_b = os.path.join(tmp, "b")
+            pack = os.path.join(tmp, "abi.aotpack.tgz")
+            stats_a = prewarm_sweep_programs(
+                sA, cA, tof_mask=mA,
+                cache=compile_pool.AOTCache(root=root_a, fingerprint=fpA),
+                **layout)
+            exported = compile_pool.export_cache_pack(pack,
+                                                      cache_root=root_a)
+            with tarfile.open(pack, "r:gz") as tar:
+                manifest = json.load(
+                    tar.extractfile(compile_pool.PACK_MANIFEST))
+            missing = [k for k, m in manifest["entries"].items()
+                       if m.get("abi_version") != abi.ABI_VERSION
+                       or not m.get("abi_bucket")]
+            if missing:
+                print("selftest: FAIL -- pack entries missing "
+                      f"abi_version/abi_bucket metadata: {missing}")
+                return 1
+            compile_pool.import_cache_pack(pack, cache_root=root_b)
+
+            clear_program_caches()
+            stats_b = prewarm_sweep_programs(
+                sB, cB, tof_mask=mB,
+                cache=compile_pool.AOTCache(root=root_b, fingerprint=fpB),
+                **layout)
+            if stats_b.compiled != 0 or stats_b.loaded != int(stats_a):
+                print("selftest: FAIL -- mechanism B recompiled from "
+                      f"mechanism A's pack (compiled={stats_b.compiled}, "
+                      f"loaded={stats_b.loaded}, expected "
+                      f"loaded={int(stats_a)})")
+                return 1
+            out = sweep_steady_state(sB, cB, tof_mask=mB,
+                                     check_stability=True)
+            if not bool(np.all(np.asarray(out["success"]))):
+                print("selftest: FAIL -- pack-warmed cross-mechanism "
+                      "sweep did not converge")
+                return 1
+    finally:
+        if prev is None:
+            os.environ.pop(abi.ABI_ENV, None)
+        else:
+            os.environ[abi.ABI_ENV] = prev
+        clear_program_caches()
+    print(f"selftest: OK -- ABI cross-mechanism: {exported['entries']} "
+          f"bucket-keyed entries from mechanism A warmed mechanism B "
+          f"({stats_b.loaded} loaded / 0 compiled), sweep converged")
     return 0
 
 
