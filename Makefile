@@ -13,12 +13,17 @@
 # more than 2 counted host syncs. `aot-pack-selftest` round-trips the
 # shippable AOT cache pack (prewarm -> export -> import ->
 # prewarm-from-pack with zero compiles -> bit-identical sweep).
+# `obs-check` is the observability lane (docs/observability.md):
+# tools/obsview.py --selftest --sweep round-trips a Chrome trace,
+# verifies span parenting + sync-label fidelity against a real traced
+# sweep, and lints the Prometheus metrics exposition.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-all lint \
-	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest
+	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest \
+	obs-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -60,3 +65,6 @@ bench-smoke:
 
 aot-pack-selftest:
 	env JAX_PLATFORMS=cpu python tools/aot_pack.py selftest
+
+obs-check:
+	env JAX_PLATFORMS=cpu python tools/obsview.py --selftest --sweep

@@ -54,6 +54,45 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# Trace output directory: ``--trace DIR`` on any bench mode, or the
+# PYCATKIN_TRACE env knob (docs/index.md env registry). When set, every
+# mode writes Perfetto-loadable Chrome trace JSON plus the run manifest
+# there; when unset, tracing costs nothing beyond the (host-side,
+# sync-free) event bookkeeping the profiler already does.
+TRACE_DIR = os.environ.get("PYCATKIN_TRACE") or None
+
+
+def _strip_trace_arg(argv):
+    """Pop ``--trace DIR`` out of ``argv`` in place (so the mode
+    routing and the journal argparse never see it) and return the
+    directory, falling back to the module default (PYCATKIN_TRACE)."""
+    out = TRACE_DIR
+    while "--trace" in argv:
+        k = argv.index("--trace")
+        if k + 1 >= len(argv):
+            raise SystemExit("bench.py: --trace needs a directory")
+        out = argv[k + 1]
+        del argv[k:k + 2]
+    return out
+
+
+def _write_trace(name, trace):
+    """Write one run trace (and the run manifest, once) under
+    TRACE_DIR; no-op when tracing is off."""
+    if not TRACE_DIR:
+        return None
+    from pycatkin_tpu.obs import run_manifest, write_chrome_trace
+    os.makedirs(TRACE_DIR, exist_ok=True)
+    path = os.path.join(TRACE_DIR, f"{name}.trace.json")
+    write_chrome_trace(path, trace)
+    man_path = os.path.join(TRACE_DIR, "manifest.json")
+    if not os.path.exists(man_path):
+        with open(man_path, "w") as f:
+            json.dump(run_manifest(), f, indent=2, sort_keys=True)
+    log(f"trace -> {path}")
+    return path
+
+
 def result_fence():
     """Sweep-result timing fence; canonical implementation lives in
     :mod:`pycatkin_tpu.utils.profiling` (shared with ``run_timed`` and
@@ -342,7 +381,7 @@ def main():
         float(np.asarray(checksum(o["y"], o["activity"], o["success"])))
         return time.perf_counter() - t0, o
 
-    from pycatkin_tpu.utils import profiling
+    from pycatkin_tpu import obs
 
     # Pinned, DISCARDED warmup trial through the exact timed_trial path
     # (fence included): the first fenced trial of a process habitually
@@ -381,25 +420,26 @@ def main():
             attempt["n"] += 1
             return timed_trial(i, attempt["n"])
 
-        n_rescue_before = len(profiling.peek_events("rescue"))
-        n_span_before = len(profiling.peek_events("span"))
-        sync_before = profiling.sync_count()
-        w, out = call_with_backend_retry(trial_once,
-                                         label=f"timed trial {i}")
+        # Run-scoped trace: the trial's spans, rescue events and
+        # counted syncs are read off ITS OWN trace (retries included --
+        # the trace wraps the retry wrapper) instead of slicing the
+        # process-global event list by before/after indices.
+        with obs.run_trace(f"trial {i}") as tr:
+            w, out = call_with_backend_retry(trial_once,
+                                             label=f"timed trial {i}")
         walls.append(w)
         last = out
-        trial_spans.append(_span_totals(
-            profiling.peek_events("span")[n_span_before:]))
-        trial_syncs.append(profiling.sync_count() - sync_before)
+        trial_spans.append(_span_totals(tr.peek("span")))
+        trial_syncs.append(tr.sync_count)
         # Per-trial rescue funnel (straggler forensics for the trial
         # wall variance): each rescue pass records how many lanes it
         # received and how many stayed failed.
         rescues = [{"pass": ev.get("label"),
                     "n_failed": ev.get("n_failed"),
                     "n_remaining": ev.get("n_remaining")}
-                   for ev in
-                   profiling.peek_events("rescue")[n_rescue_before:]]
+                   for ev in tr.peek("rescue")]
         trial_rescues.append(rescues)
+        _write_trace(f"trial_{i}", tr)
         log(f"trial {i}: {w:.3f} s, rescue funnel: "
             f"{[(r['pass'], r['n_failed']) for r in rescues] or 'clean'}")
     wall = sorted(walls)[1]
@@ -417,21 +457,18 @@ def main():
     # homogeneous -- any trial exceeding the median by >10% names the
     # span whose duration grew the most between the median and slowest
     # trials instead of leaving the outlier as an anonymous number.
+    # The attribution itself lives in pycatkin_tpu.obs (shared with
+    # tools/obsview.py, so the CLI and the bench can never disagree).
     max_over_median = round(max(walls) / wall, 3)
+    attr = obs.attribute_outlier(trial_spans, walls, threshold=1.1)
     outlier_span = None
-    if max_over_median > 1.1:
-        slow_i = walls.index(max(walls))
-        med_i = walls.index(wall)
-        labels = set(trial_spans[slow_i]) | set(trial_spans[med_i])
-        deltas = {lbl: trial_spans[slow_i].get(lbl, 0.0)
-                  - trial_spans[med_i].get(lbl, 0.0) for lbl in labels}
-        if deltas:
-            dom = max(deltas, key=lambda k: deltas[k])
-            outlier_span = {"label": dom,
-                            "extra_s": round(deltas[dom], 3)}
-            log(f"slow-trial outlier: trial {slow_i} "
-                f"({max(walls):.3f} s vs median {wall:.3f} s); "
-                f"dominant span: {dom} (+{deltas[dom]:.3f} s)")
+    if attr:
+        outlier_span = {"label": attr["label"],
+                        "extra_s": attr["extra_s"]}
+        log(f"slow-trial outlier: trial {attr['trial']} "
+            f"({max(walls):.3f} s vs median {wall:.3f} s); "
+            f"dominant span: {attr['label']} "
+            f"(+{attr['extra_s']:.3f} s)")
 
     vs_baseline = None
     if have_ref:
@@ -507,6 +544,11 @@ def main():
         "max_over_median": max_over_median,
         "variance_ok": max_over_median < 1.1,
         "outlier_span": outlier_span,
+        # Self-describing record: git state, backend, mesh, every set
+        # PYCATKIN_* knob, ABI bucket and aot-key version that produced
+        # these numbers (pycatkin_tpu.obs.manifest schema).
+        "manifest": obs.run_manifest(mesh=mesh),
+        "trace_dir": TRACE_DIR,
     }
 
     # Regression tripwire vs the checked-in prior round (VERDICT r3
@@ -594,9 +636,15 @@ def smoke_main():
         prewarm_s = time.perf_counter() - t0
         profiling.reset_sync_count()
         t0 = time.perf_counter()
-        with profiling.sync_budget() as budget:
-            out = sweep_steady_state(spec, conds, tof_mask=mask,
-                                     check_stability=True)
+        # Run-scoped trace OUTSIDE the budget: sync_budget() measures
+        # the ambient trace, so entering the trace first makes the
+        # budget read the smoke sweep's own counters -- and the
+        # exported Chrome trace below must reproduce them exactly.
+        from pycatkin_tpu import obs
+        with obs.run_trace("smoke sweep") as tr:
+            with profiling.sync_budget() as budget:
+                out = sweep_steady_state(spec, conds, tof_mask=mask,
+                                         check_stability=True)
         wall = time.perf_counter() - t0
 
         # ABI zero-compile gate (PYCATKIN_ABI=1 only): a second
@@ -627,6 +675,64 @@ def smoke_main():
     breach = clean and budget.count > max_syncs
     budget_breach = (int(n_prog) > PREWARM_PROGRAM_BUDGET
                      or planned > PREWARM_PROGRAM_BUDGET)
+
+    # Observability gates (ISSUE-8): the exported Chrome trace must
+    # parse and reproduce the counted sync labels verbatim (on the
+    # clean fused path: exactly the "fused tail bundle" sync); the
+    # metrics snapshot must have seen the prewarm's compiles/cache
+    # traffic and this sweep's lanes; the run manifest must list every
+    # PYCATKIN_* knob currently set (PYCATKIN_AOT_CACHE above at
+    # minimum).
+    from pycatkin_tpu.obs import (load_trace, run_manifest,
+                                  write_chrome_trace)
+    from pycatkin_tpu.obs import metrics as obs_metrics
+    from pycatkin_tpu.parallel.batch import _fused_enabled
+    trace_ok, trace_err = True, None
+    scratch = None
+    trace_dir = TRACE_DIR
+    if trace_dir is None:
+        scratch = tempfile.TemporaryDirectory(prefix="pycatkin_trace_")
+        trace_dir = scratch.name
+    else:
+        os.makedirs(trace_dir, exist_ok=True)
+    try:
+        trace_path = os.path.join(trace_dir, "smoke.trace.json")
+        write_chrome_trace(trace_path, tr)
+        tobj = load_trace(trace_path)
+        sync_names = [ev["name"] for ev in tobj["traceEvents"]
+                      if ev.get("cat") == "sync"]
+        if sync_names != budget.labels:
+            raise ValueError(f"trace sync labels {sync_names} != "
+                             f"budget labels {budget.labels}")
+        if clean and _fused_enabled() \
+                and "fused tail bundle" not in sync_names:
+            raise ValueError("clean fused sweep trace is missing the "
+                             "'fused tail bundle' sync")
+    except (OSError, ValueError, KeyError) as e:
+        trace_ok, trace_err = False, str(e)
+    finally:
+        if scratch is not None:
+            scratch.cleanup()
+
+    counters = obs_metrics.snapshot()["counters"]
+
+    def _ctotal(name):
+        return sum(counters.get(name, {}).values())
+
+    compile_traffic = (_ctotal("pycatkin_compile_total")
+                       + _ctotal("pycatkin_aot_cache_hits_total")
+                       + _ctotal("pycatkin_aot_cache_misses_total"))
+    metrics_ok = (compile_traffic > 0
+                  and _ctotal("pycatkin_lanes_solved_total") >= n
+                  and _ctotal("pycatkin_host_syncs_total") > 0)
+
+    manifest = run_manifest()
+    set_knobs = sorted(k for k in os.environ
+                       if k.startswith("PYCATKIN_"))
+    manifest_ok = sorted(manifest.get("env") or {}) == set_knobs
+    if TRACE_DIR:
+        with open(os.path.join(TRACE_DIR, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
     result = {
         "metric": metric + " (smoke)",
         "n_points": n,
@@ -650,8 +756,27 @@ def smoke_main():
         "abi_zero_compile_ok": abi_zero_compile_ok,
         "lint_ok": True,
         "lint_findings": 0,
+        "trace_ok": trace_ok,
+        "trace_error": trace_err,
+        "metrics_ok": metrics_ok,
+        "manifest_ok": manifest_ok,
+        "manifest": manifest,
     }
     print(json.dumps(result))
+    if not trace_ok:
+        log(f"bench-smoke: FAIL -- trace export gate: {trace_err}")
+        return 1
+    if not metrics_ok:
+        log(f"bench-smoke: FAIL -- metrics snapshot gate: compile "
+            f"traffic {compile_traffic}, lanes "
+            f"{_ctotal('pycatkin_lanes_solved_total')}, syncs "
+            f"{_ctotal('pycatkin_host_syncs_total')}")
+        return 1
+    if not manifest_ok:
+        log(f"bench-smoke: FAIL -- manifest env gate: manifest lists "
+            f"{sorted(manifest.get('env') or {})}, process has "
+            f"{set_knobs}")
+        return 1
     if not abi_zero_compile_ok:
         log(f"bench-smoke: FAIL -- second mechanism in the warm ABI "
             f"bucket compiled {abi_marginal_compiled} program(s) "
@@ -719,14 +844,20 @@ def journal_main(argv):
     sim, spec, conds, mask, metric, _ = _build_problem()
     profiling.drain_events()        # forensics sees only this run
 
+    # Run-scoped trace: forensics reads the degradation/retry events
+    # off THIS run's trace (a fresh trace starts empty, so no stale
+    # prewarm events can leak into the report), and --trace exports it.
+    from pycatkin_tpu import obs
+
     if args.journal:
         from pycatkin_tpu.robustness import chunked_sweep_steady_state
 
         t0 = time.perf_counter()
-        out, report = chunked_sweep_steady_state(
-            spec, conds, chunk=args.chunk, tof_mask=mask,
-            opts=sim.solver_options(), check_stability=True,
-            journal=args.journal, resume=args.resume, verbose=True)
+        with obs.run_trace("journaled chunked sweep") as tr:
+            out, report = chunked_sweep_steady_state(
+                spec, conds, chunk=args.chunk, tof_mask=mask,
+                opts=sim.solver_options(), check_stability=True,
+                journal=args.journal, resume=args.resume, verbose=True)
         wall = time.perf_counter() - t0
 
         n = int(np.asarray(out["success"]).shape[0])
@@ -749,9 +880,10 @@ def journal_main(argv):
         from pycatkin_tpu.parallel.batch import sweep_steady_state
 
         t0 = time.perf_counter()
-        out = sweep_steady_state(spec, conds, tof_mask=mask,
-                                 opts=sim.solver_options(),
-                                 check_stability=True)
+        with obs.run_trace("forensics sweep") as tr:
+            out = sweep_steady_state(spec, conds, tof_mask=mask,
+                                     opts=sim.solver_options(),
+                                     check_stability=True)
         n_ok = int(np.sum(np.asarray(out["success"])))
         wall = time.perf_counter() - t0
 
@@ -764,12 +896,15 @@ def journal_main(argv):
         }
         events = []
 
+    _write_trace("journal" if args.journal else "forensics", tr)
+
     if args.forensics:
         from pycatkin_tpu.robustness import (format_failure_report,
                                              sweep_failure_report)
         # Ladder/retry/quarantine events recorded during THIS run that
-        # a chunked report does not already carry.
-        events = events + [ev for ev in profiling.drain_events()
+        # a chunked report does not already carry (read off the run's
+        # own trace; the manifest rides inside the forensics report).
+        events = events + [ev for ev in tr.drain()
                            if ev.get("kind") in ("degradation", "retry")]
         forensics = sweep_failure_report(out, conds=conds, events=events)
         result["forensics"] = forensics
@@ -805,7 +940,9 @@ def _prior_round_value():
 if __name__ == "__main__":
     # No arguments: the historical timing benchmark, exactly one JSON
     # line. --smoke is the CI canary; any other argument switches to
-    # the journaled chunked mode.
+    # the journaled chunked mode. --trace DIR composes with every mode
+    # (stripped here so the routing below never sees it).
+    TRACE_DIR = _strip_trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         sys.exit(smoke_main())
     elif len(sys.argv) > 1:

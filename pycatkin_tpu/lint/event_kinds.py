@@ -1,0 +1,98 @@
+"""PCL008 event-kinds: every ``record_event`` kind is documented in
+docs/failure_model.md.
+
+Structured telemetry events are addressed by their ``kind`` string (the
+first argument of ``utils.profiling.record_event`` /
+``obs.RunTrace.record``): consumers filter by kind
+(``peek_events("rescue")``, forensics' degradation/retry drain,
+``tools/obsview.py``), so an event recorded under a kind nobody
+documented is telemetry nobody will ever look at -- and a typo'd kind
+(``"degredation"``) silently vanishes from every report. The kind
+vocabulary is therefore a closed registry: the "Event-kind registry"
+table of docs/failure_model.md. A ``record_event`` call whose literal
+kind is not backticked there is a finding; dynamic (non-literal) kinds
+cannot be statically checked and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, SourceFile, register
+
+DOC_RELPATH = os.path.join("docs", "failure_model.md")
+
+# Callees whose first positional (or ``kind=``) argument is an
+# event-kind string. ``record`` alone would false-positive on every
+# unrelated .record() method, so only the profiling entry points are
+# matched.
+KIND_FUNCS = frozenset({"record_event"})
+
+
+def event_kinds(tree) -> list:
+    """(kind, node) pairs for every literal-kind ``record_event`` call
+    in one module's AST."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = getattr(func, "id", None) or getattr(func, "attr", "")
+        if fname not in KIND_FUNCS:
+            continue
+        kind_node = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                kind_node = kw.value
+        if isinstance(kind_node, ast.Constant) \
+                and isinstance(kind_node.value, str):
+            out.append((kind_node.value, node))
+    return out
+
+
+def documented_kinds(doc_path: str) -> set:
+    """Every backticked token in the failure-model doc (the event-kind
+    registry table rows; sharing the token pool with PCL002's
+    fault-site labels is harmless -- kinds and labels never collide)."""
+    with open(doc_path, encoding="utf-8") as fh:
+        return set(re.findall(r"`([^`\n]+)`", fh.read()))
+
+
+@register
+class EventKindChecker(Checker):
+    rule = "PCL008"
+    name = "event-kinds"
+    description = ("record_event kind not documented in "
+                   "docs/failure_model.md")
+    scope = ("pycatkin_tpu/",)
+
+    def __init__(self, doc_path: Optional[str] = None):
+        super().__init__()
+        self._doc_path = doc_path
+        self._documented: Optional[set] = None
+
+    @property
+    def doc_path(self) -> str:
+        return self._doc_path or os.path.join(self.root, DOC_RELPATH)
+
+    def documented(self) -> set:
+        if self._documented is None:
+            self._documented = documented_kinds(self.doc_path)
+        return self._documented
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        kinds = event_kinds(src.tree)
+        if not kinds:
+            return
+        documented = self.documented()
+        rel_doc = DOC_RELPATH.replace(os.sep, "/")
+        for kind, node in kinds:
+            if kind in documented:
+                continue
+            yield self.finding(
+                src, node,
+                f"undocumented event kind `{kind}` -- add it, "
+                f"backticked, to the event-kind registry in {rel_doc}")

@@ -1,0 +1,40 @@
+"""Run-scoped observability: trace contexts, metrics, exports.
+
+The telemetry floor under the robustness and parallel layers
+(docs/observability.md). Three pieces, each importable without JAX so
+tooling (``tools/obsview.py``, CI lanes) stays cheap:
+
+- :mod:`pycatkin_tpu.obs.trace` -- nestable :class:`RunTrace` contexts
+  (contextvars-based, thread-safe) that replace the old process-global
+  event list in :mod:`pycatkin_tpu.utils.profiling`. The legacy
+  ``record_event``/``span``/``host_sync``/``sync_budget`` API keeps
+  working by routing to the ambient trace (root-trace fallback).
+- :mod:`pycatkin_tpu.obs.metrics` -- a process-wide registry of
+  counters/gauges/histograms wired through the hot layers, exportable
+  as a JSON snapshot or Prometheus text exposition.
+- :mod:`pycatkin_tpu.obs.export` / :mod:`pycatkin_tpu.obs.manifest` --
+  Chrome ``trace_event`` JSON (Perfetto-loadable), span-tree summaries
+  shared by bench.py and ``tools/obsview.py``, and the self-describing
+  run manifest attached to bench JSON, journal headers and forensics
+  reports.
+"""
+
+from .export import (attribute_outlier, chrome_trace,  # noqa: F401
+                     format_span_table, load_trace, span_summary,
+                     span_tree, top_spans, write_chrome_trace)
+from .manifest import run_manifest  # noqa: F401
+from .metrics import (counter, default_registry, gauge,  # noqa: F401
+                      histogram, prometheus_text,
+                      validate_prometheus_text)
+from .metrics import snapshot as metrics_snapshot  # noqa: F401
+from .trace import (RunTrace, current_span_id, current_trace,  # noqa: F401
+                    root_trace, run_trace)
+
+__all__ = [
+    "RunTrace", "run_trace", "current_trace", "current_span_id",
+    "root_trace", "chrome_trace", "write_chrome_trace", "load_trace",
+    "span_tree", "span_summary", "top_spans", "format_span_table",
+    "attribute_outlier", "run_manifest", "counter", "gauge",
+    "histogram", "default_registry", "metrics_snapshot",
+    "prometheus_text", "validate_prometheus_text",
+]

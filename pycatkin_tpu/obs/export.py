@@ -1,0 +1,220 @@
+"""Trace export and span analysis.
+
+Chrome ``trace_event`` JSON (the Trace Event Format; loadable in
+Perfetto / ``chrome://tracing``) from a :class:`~.trace.RunTrace`,
+plus the span-tree / top-N / outlier-attribution analysis shared by
+``tools/obsview.py`` and bench.py (which used to hand-roll its
+``outlier_span`` logic). Pure host-side JSON shuffling -- no JAX.
+"""
+
+from __future__ import annotations
+
+import json
+
+_US = 1e6      # trace_event timestamps are microseconds
+
+
+def chrome_trace(trace) -> dict:
+    """A :class:`RunTrace` as a Chrome ``trace_event`` JSON object.
+
+    Spans become complete ("X") events (``ts``/``dur`` microseconds
+    relative to the trace start, one row per recording thread); counted
+    host syncs and every other event kind (degradation, rescue, retry)
+    become instant ("i") events. Sync instants are named EXACTLY by
+    their counted sync label, so the exported span tree reproduces the
+    sync-budget labels (``sync_labels()``) verbatim.
+    """
+    base = trace.t0
+    pid = 1
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+               "args": {"name": f"pycatkin run '{trace.name}' "
+                                f"(trace {trace.trace_id})"}}]
+    for ev in trace.peek():
+        kind = ev.get("kind")
+        tid = ev.get("tid", 0)
+        args = {k: v for k, v in ev.items()
+                if k not in ("kind", "t", "t0", "ts", "dur", "tid")
+                and _jsonable(v)}
+        if kind == "span":
+            t0 = ev.get("t0")
+            dur = float(ev.get("dur", 0.0))
+            ts = ((t0 - base) if t0 is not None
+                  else (ev.get("t", base) - base) - dur)
+            events.append({
+                "name": str(ev.get("label", "span")), "cat": "span",
+                "ph": "X", "ts": round(ts * _US, 1),
+                "dur": round(dur * _US, 1),
+                "pid": pid, "tid": tid, "args": args})
+        elif kind == "sync":
+            ts = ev.get("ts", ev.get("t", base))
+            events.append({
+                "name": str(ev.get("label", "")), "cat": "sync",
+                "ph": "i", "ts": round((ts - base) * _US, 1),
+                "s": "t", "pid": pid, "tid": tid, "args": args})
+        else:
+            events.append({
+                "name": str(kind), "cat": str(kind),
+                "ph": "i", "ts": round((ev.get("t", base) - base) * _US, 1),
+                "s": "t", "pid": pid, "tid": tid, "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_name": trace.name,
+                          "trace_id": trace.trace_id,
+                          "sync_count": trace.sync_count,
+                          "sync_labels": list(trace.sync_labels)}}
+
+
+def _jsonable(v) -> bool:
+    return isinstance(v, (str, int, float, bool, type(None)))
+
+
+def write_chrome_trace(path: str, trace) -> dict:
+    """Serialize :func:`chrome_trace` to ``path``; returns the object."""
+    obj = chrome_trace(trace)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+    return obj
+
+
+def load_trace(path: str) -> dict:
+    """Parse a Chrome trace JSON file (obsview's input)."""
+    with open(path, encoding="utf-8") as fh:
+        obj = json.load(fh)
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise ValueError(f"{path}: not a Chrome trace_event file "
+                         f"(no traceEvents key)")
+    return obj
+
+
+# -- span-tree analysis (events = legacy span event dicts OR the
+#    traceEvents of a loaded Chrome trace) -----------------------------
+
+def _as_span_rows(events) -> list:
+    """Normalize either representation into
+    ``{label, dur_s, span_id, parent_id}`` rows."""
+    rows = []
+    for ev in events:
+        if ev.get("ph") == "X":          # Chrome trace event
+            rows.append({"label": ev.get("name", "span"),
+                         "dur_s": float(ev.get("dur", 0.0)) / _US,
+                         "span_id": ev.get("args", {}).get("span_id"),
+                         "parent_id": ev.get("args", {}).get("parent_id")})
+        elif ev.get("kind") == "span":   # RunTrace event
+            rows.append({"label": ev.get("label", "span"),
+                         "dur_s": float(ev.get("dur", 0.0)),
+                         "span_id": ev.get("span_id"),
+                         "parent_id": ev.get("parent_id")})
+    return rows
+
+
+def span_tree(events) -> list:
+    """Root span nodes ``{label, dur_s, self_s, span_id, children}``
+    rebuilt from parent links (spans with an unknown/absent parent are
+    roots -- legacy events without ids degrade to a flat list)."""
+    rows = _as_span_rows(events)
+    nodes = {}
+    for i, r in enumerate(rows):
+        key = r["span_id"] if r["span_id"] is not None else f"anon{i}"
+        nodes[key] = {**r, "children": []}
+    roots = []
+    for key, node in nodes.items():
+        parent = node["parent_id"]
+        if parent is not None and parent in nodes:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node["self_s"] = round(
+            max(0.0, node["dur_s"]
+                - sum(c["dur_s"] for c in node["children"])), 6)
+    return roots
+
+
+def span_summary(events) -> list:
+    """Per-label aggregate rows (total/self seconds, count, max),
+    sorted by total descending -- the obsview table."""
+    agg: dict = {}
+    def walk(node):
+        row = agg.setdefault(node["label"],
+                             {"label": node["label"], "count": 0,
+                              "total_s": 0.0, "self_s": 0.0,
+                              "max_s": 0.0})
+        row["count"] += 1
+        row["total_s"] += node["dur_s"]
+        row["self_s"] += node["self_s"]
+        row["max_s"] = max(row["max_s"], node["dur_s"])
+        for c in node["children"]:
+            walk(c)
+    for root in span_tree(events):
+        walk(root)
+    rows = sorted(agg.values(), key=lambda r: -r["total_s"])
+    for r in rows:
+        for k in ("total_s", "self_s", "max_s"):
+            r[k] = round(r[k], 6)
+    return rows
+
+
+def top_spans(events, n: int = 10) -> list:
+    """The N individually slowest spans ``{label, dur_s}``."""
+    rows = sorted(_as_span_rows(events), key=lambda r: -r["dur_s"])
+    return [{"label": r["label"], "dur_s": round(r["dur_s"], 6)}
+            for r in rows[:n]]
+
+
+def format_span_table(events, top: int = 0) -> str:
+    """Human span-tree rendering: indented tree + per-label summary
+    (+ top-N slowest individual spans when ``top`` > 0)."""
+    lines = []
+    def walk(node, depth):
+        lines.append(f"{'  ' * depth}{node['label']:<40.40s} "
+                     f"total {node['dur_s']*1e3:10.3f} ms  "
+                     f"self {node['self_s']*1e3:10.3f} ms")
+        for c in sorted(node["children"], key=lambda c: -c["dur_s"]):
+            walk(c, depth + 1)
+    for root in sorted(span_tree(events), key=lambda r: -r["dur_s"]):
+        walk(root, 0)
+    lines.append("")
+    lines.append(f"{'label':<40s} {'count':>5s} {'total ms':>12s} "
+                 f"{'self ms':>12s} {'max ms':>12s}")
+    for r in span_summary(events):
+        lines.append(f"{r['label']:<40.40s} {r['count']:>5d} "
+                     f"{r['total_s']*1e3:>12.3f} "
+                     f"{r['self_s']*1e3:>12.3f} "
+                     f"{r['max_s']*1e3:>12.3f}")
+    if top:
+        lines.append("")
+        lines.append(f"top {top} slowest spans:")
+        for r in top_spans(events, top):
+            lines.append(f"  {r['label']:<40.40s} "
+                         f"{r['dur_s']*1e3:>12.3f} ms")
+    return "\n".join(lines)
+
+
+def attribute_outlier(trial_spans: list, walls: list,
+                      threshold: float = 1.1):
+    """Name the span that dominates a slow-trial outlier.
+
+    ``trial_spans`` is one ``{label: total_seconds}`` dict per trial,
+    ``walls`` the matching trial walls. When the slowest trial exceeds
+    the median by more than ``threshold``, returns ``{"label",
+    "extra_s", "trial", "max_over_median"}`` for the span whose total
+    grew the most between the median and slowest trials (bench.py's
+    variance-forensics gate); else None.
+    """
+    if not walls or len(walls) != len(trial_spans):
+        return None
+    median = sorted(walls)[len(walls) // 2]
+    if median <= 0:
+        return None
+    max_over_median = round(max(walls) / median, 3)
+    if max_over_median <= threshold:
+        return None
+    slow_i = walls.index(max(walls))
+    med_i = walls.index(median)
+    labels = set(trial_spans[slow_i]) | set(trial_spans[med_i])
+    deltas = {lbl: trial_spans[slow_i].get(lbl, 0.0)
+              - trial_spans[med_i].get(lbl, 0.0) for lbl in labels}
+    if not deltas:
+        return None
+    dom = max(deltas, key=lambda k: deltas[k])
+    return {"label": dom, "extra_s": round(deltas[dom], 3),
+            "trial": slow_i, "max_over_median": max_over_median}

@@ -1,0 +1,129 @@
+"""The run manifest: a self-describing record of what executed.
+
+One dict answering, machine-readably, "what code, what backend, what
+knobs" for a run -- attached to bench JSON, journal headers
+(robustness/journal.py) and forensics reports (robustness/forensics.py)
+so an artifact can be interpreted long after the shell that produced it
+is gone. Every field is best-effort: a manifest must never kill the
+run it describes, so each probe degrades to None instead of raising.
+
+Schema (docs/observability.md): ``schema``, ``git``, ``backend``
+(platform / device_count / device_kind), ``mesh`` (when given), ``env``
+(every SET ``PYCATKIN_*`` knob, verbatim), ``registered_env_keys`` (the
+PCL006 registry, so a reader can tell "unset" from "unknown"),
+``jax_platforms``, ``abi`` (enabled + bucket fingerprint when a spec is
+given), ``aot_key_version``, ``program_budget``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+SCHEMA = "pycatkin-run-manifest/v1"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _git_describe():
+    try:
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _backend_info():
+    # Only report a backend that is ALREADY initialized: a manifest
+    # probe must not pay (or fail) a backend bring-up of its own.
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+        devs = jax.devices()
+        return {"platform": devs[0].platform,
+                "device_count": len(devs),
+                "device_kind": devs[0].device_kind}
+    except Exception:
+        return None
+
+
+def _mesh_info(mesh):
+    if mesh is None:
+        return None
+    try:
+        return {"devices": int(mesh.devices.size),
+                "axis_names": [str(a) for a in mesh.axis_names],
+                "shape": {str(k): int(v)
+                          for k, v in dict(mesh.shape).items()}}
+    except Exception:
+        return None
+
+
+def _registered_env_keys():
+    try:
+        from ..lint.env_registry import DOC_RELPATH, registered_keys
+        return sorted(registered_keys(
+            os.path.join(_REPO_ROOT, DOC_RELPATH)))
+    except Exception:
+        return None
+
+
+def _abi_info(spec):
+    info = {"enabled": False, "bucket": None}
+    try:
+        from ..frontend import abi
+        info["enabled"] = abi.abi_enabled()
+        if spec is not None:
+            if isinstance(spec, abi.AbiLowered):
+                info["bucket"] = spec.abi_fingerprint
+            else:
+                low = abi.maybe_lower(spec)
+                if low is not None:
+                    info["bucket"] = low.abi_fingerprint
+    except Exception:
+        pass
+    return info
+
+
+def _aot_key_version():
+    try:
+        from ..parallel.compile_pool import _KEY_VERSION
+        return _KEY_VERSION
+    except Exception:
+        return None
+
+
+def _program_budget():
+    # batch imports JAX; only consult it when the caller already did.
+    if "pycatkin_tpu.parallel.batch" not in sys.modules:
+        return None
+    try:
+        from ..parallel.batch import PREWARM_PROGRAM_BUDGET
+        return int(PREWARM_PROGRAM_BUDGET)
+    except Exception:
+        return None
+
+
+def run_manifest(mesh=None, spec=None) -> dict:
+    """Build the manifest (see module docstring). ``mesh`` and ``spec``
+    are optional context the caller already holds; everything else is
+    probed from the process environment."""
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.startswith("PYCATKIN_")}
+    return {
+        "schema": SCHEMA,
+        "git": _git_describe(),
+        "backend": _backend_info(),
+        "mesh": _mesh_info(mesh),
+        "env": env,
+        "registered_env_keys": _registered_env_keys(),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "abi": _abi_info(spec),
+        "aot_key_version": _aot_key_version(),
+        "program_budget": _program_budget(),
+    }

@@ -17,6 +17,7 @@ simply stop improving, which is the price of SIMD execution.
 from __future__ import annotations
 
 import os
+import time as _time_mod
 from functools import lru_cache, partial
 from typing import Callable, Optional
 
@@ -28,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import engine
 from ..frontend import abi as _abi
 from ..frontend.spec import Conditions, ModelSpec
+from ..obs import metrics as _metrics
 from ..solvers.newton import SolverOptions
 from ..solvers.ode import ODEOptions
 from ..utils.profiling import host_sync, record_event, span
@@ -206,6 +208,10 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
             compile_pool.unregister(spec, key)
             record_event("degradation", label="aot:fallback",
                          error=f"{type(e).__name__}: {e}"[:200])
+            _metrics.counter(
+                "pycatkin_aot_fallback_total",
+                "registered AOT executables evicted to the jit "
+                "fallback").inc()
     # Registry miss: the jitted fallback traces + compiles SYNCHRONOUSLY
     # on its first call at this shape, which is exactly the in-band
     # recompile the variance forensics hunt for -- the span carries the
@@ -1218,6 +1224,13 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     # list append on already-materialized ints).
     record_event("rescue", label=f"rescue[{strategy}]",
                  n_failed=int(n_failed), n_remaining=n_remaining)
+    _metrics.counter("pycatkin_rescue_lanes_total",
+                     "failed lanes entering each rescue strategy").inc(
+                         int(n_failed), strategy=str(strategy))
+    _metrics.counter("pycatkin_rescued_lanes_total",
+                     "lanes recovered per rescue strategy").inc(
+                         int(n_failed) - n_remaining,
+                         strategy=str(strategy))
     if not got.any():
         return res, n_remaining
     x = np.array(res.x)  # sync-ok: failure path, writable host merge copies
@@ -1285,6 +1298,10 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
     # masks/diagnostics are lane-shaped and pass through unchanged.
     low = _abi.maybe_lower(spec)
     if low is not None:
+        _metrics.counter(
+            "pycatkin_abi_bucket_sweeps_total",
+            "sweeps dispatched per ABI shape bucket").inc(
+                bucket=low.abi_fingerprint)
         out = sweep_steady_state(low, low.pad_conditions(conds),
                                  tof_mask=low.pad_tof_mask(tof_mask),
                                  x0=low.pad_x0(x0), opts=opts, mesh=mesh,
@@ -1292,6 +1309,30 @@ def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
                                  pos_jac_tol=pos_jac_tol)
         out["y"] = low.unpad_y(jnp.asarray(out["y"]))
         return out
+
+    # Sweep-level throughput instruments: lane count is a host-side
+    # shape read, the wall a perf_counter pair -- nothing device-
+    # visible is added (the sync budget and dispatch count are pinned
+    # by tests/test_sync_budget.py).
+    _metrics.counter("pycatkin_lanes_solved_total",
+                     "lanes entering sweep_steady_state").inc(
+                         jax.tree_util.tree_leaves(conds)[0].shape[0])
+    _t_sweep = _time_mod.perf_counter()
+    try:
+        return _sweep_steady_state_tail(spec, conds, tof_mask, x0, opts,
+                                        mesh, check_stability,
+                                        pos_jac_tol)
+    finally:
+        _metrics.histogram(
+            "pycatkin_sweep_wall_seconds",
+            "sweep_steady_state wall time").observe(
+                _time_mod.perf_counter() - _t_sweep)
+
+
+def _sweep_steady_state_tail(spec, conds, tof_mask, x0, opts, mesh,
+                             check_stability, pos_jac_tol):
+    """Post-ABI-gate body of :func:`sweep_steady_state` (split out so
+    the metrics wrapper above stays flat)."""
 
     # Two-phase solve: a capped single-attempt first pass (sized for the
     # ~p99 lane), then host-side rescue of the failed subset with the
@@ -1414,6 +1455,16 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
         tofs, act, neg = out[pos], out[pos + 1], out[pos + 2]
         pos += 3
     nf, nq, n_amb, n_dem, n_neg = (int(c) for c in out[pos])
+
+    # Escalation instrument from the already-materialized bundle
+    # counts: host ints only, no extra syncs or dispatches on any tier.
+    # (Quarantined lanes are counted by ladder.record_quarantine -- any
+    # nq > 0 run reaches it through the legacy tail.)
+    if check_stability and n_amb > 0:
+        _metrics.counter(
+            "pycatkin_tier2_escalations_total",
+            "tier-0 certificate abstentions escalated to the tier-2 "
+            "eigensolve").inc(n_amb)
 
     if nf == 0 and (not check_stability
                     or (n_amb == 0 and n_dem == 0)):
@@ -1994,6 +2045,9 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         exe = call_with_backend_retry(
             lambda: job["prog"].lower(*job["args"]).compile(),
             label=f"compile:{job['label']}")
+        _metrics.counter("pycatkin_compile_total",
+                         "fresh XLA compiles through the compile "
+                         "pool").inc()
         cache.save(job["key"], exe,
                    sharding=compile_pool.args_sharding_fingerprint(
                        job["args"]))
@@ -2226,6 +2280,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     stats.cache_writes = cache.writes
     stats.executed = n_executed
     stats.cache = cache.stats()
+    _metrics.counter("pycatkin_prewarm_programs_total",
+                     "programs ensured by prewarm, by how they were "
+                     "obtained").inc(n_compiled, source="compiled")
+    _metrics.counter("pycatkin_prewarm_programs_total").inc(
+        n_loaded, source="loaded")
     _log(f"{int(stats)} programs ({n_compiled} compiled, {n_loaded} "
          f"loaded/registered, {n_executed} executed once)")
     return stats

@@ -55,6 +55,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+
 _DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), ".jax_aot_cache")
 
@@ -263,6 +265,13 @@ class AOTCache:
     def _path(self, key: str) -> str:
         return os.path.join(self.root, f"{key}.aot")
 
+    def _tick(self, which: str):
+        """One cache outcome: the per-instance counter (``stats()``)
+        plus the process-wide metrics registry."""
+        setattr(self, which, getattr(self, which) + 1)
+        _metrics.counter(f"pycatkin_aot_cache_{which}_total",
+                         f"AOT executable cache {which}").inc()
+
     def load(self, key: str):
         """Deserialize the executable cached under ``key``.
 
@@ -281,13 +290,13 @@ class AOTCache:
                 entry = pickle.load(fh)
         except (OSError, pickle.UnpicklingError, EOFError, ValueError,
                 AttributeError, ImportError):
-            self.misses += 1
+            self._tick("misses")
             return None
         dev = jax.devices()[0]
         if (entry.get("jax") != jax.__version__
                 or entry.get("backend") != dev.platform
                 or entry.get("device_kind") != dev.device_kind):
-            self.misses += 1            # stale toolchain: plain miss
+            self._tick("misses")            # stale toolchain: plain miss
             return None
         # A sharded executable bakes in its mesh's device assignment;
         # deserializing it into a process with a different device
@@ -296,10 +305,10 @@ class AOTCache:
         # change -- only the spec fingerprint is a hard error.
         if entry.get("sharding") and \
                 entry.get("devices") != jax.device_count():
-            self.misses += 1
+            self._tick("misses")
             return None
         if entry.get("fingerprint") != self.fingerprint:
-            self.mismatches += 1
+            self._tick("mismatches")
             raise CacheMismatch(
                 f"AOT cache entry {os.path.basename(path)} was compiled "
                 f"for spec fingerprint "
@@ -310,9 +319,9 @@ class AOTCache:
             exe = se.deserialize_and_load(
                 entry["payload"], entry["in_tree"], entry["out_tree"])
         except Exception:               # corrupt payload: plain miss
-            self.misses += 1
+            self._tick("misses")
             return None
-        self.hits += 1
+        self._tick("hits")
         return exe
 
     def save(self, key: str, compiled, sharding: str = "") -> bool:
@@ -354,7 +363,7 @@ class AOTCache:
             os.replace(tmp, self._path(key))
         except Exception:
             return False
-        self.writes += 1
+        self._tick("writes")
         return True
 
     def stats(self) -> dict:
@@ -610,5 +619,9 @@ def import_cache_pack(pack_path: str, cache_root: str | None = None,
             os.replace(tmp, os.path.join(root, name))
             imported += 1
             total += len(blob)
+    _metrics.counter("pycatkin_aot_pack_imports_total",
+                     "cache-pack import operations").inc()
+    _metrics.counter("pycatkin_aot_pack_entries_imported_total",
+                     "cache entries landed by pack imports").inc(imported)
     return {"root": root, "imported": imported,
             "foreign_toolchain": foreign, "bytes": total}

@@ -163,6 +163,7 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
         # inputs are still on disk, so re-solve them here in-process
         # (CPU/host devices of the parent) rather than losing the whole
         # sweep to one dead worker.
+        from ..obs import metrics as _metrics
         from ..utils.profiling import record_event
         still_failed = []
         for i in failed:
@@ -171,12 +172,17 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                          rung="host-fallback",
                          detail="worker process failed/timed out; "
                                 "re-solving block in-process")
+            _metrics.counter("pycatkin_dispatch_salvaged_blocks_total",
+                             "worker blocks re-solved in-process").inc()
             try:
                 _worker(cfg_path, inject_faults=False)
             except Exception as exc:  # noqa: BLE001 - reported below
                 record_event("degradation", label=f"dispatch:block:{i}",
                              rung="abandoned",
                              detail=f"in-process re-solve failed: {exc}")
+                _metrics.counter(
+                    "pycatkin_dispatch_abandoned_blocks_total",
+                    "worker blocks abandoned after salvage failed").inc()
                 still_failed.append(i)
         failed = still_failed
     if failed:

@@ -21,11 +21,13 @@ permanent loss at an exact chunk.
 
 from __future__ import annotations
 
+import contextvars
 from contextlib import nullcontext
 
 import numpy as np
 
 from ..solvers.newton import SolverOptions
+from ..utils.profiling import span
 from . import faults
 from .journal import SweepJournal, conditions_fingerprint
 from .ladder import DegradationPolicy, run_chunk_with_ladder
@@ -176,8 +178,9 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
                 out = {k: np.asarray(v) for k, v in out.items()}
             return faults.transform(_site, out)
 
-        return run_chunk_with_ladder(
-            run, label=site, policy=policy, validate=chunk_verdict)
+        with span("chunk solve", chunk=ci, lanes=b - a):
+            return run_chunk_with_ladder(
+                run, label=site, policy=policy, validate=chunk_verdict)
 
     todo = [ci for ci in range(n_chunks) if ci not in done]
     # One-deep double buffering: while the main thread triages/journals
@@ -191,7 +194,18 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
     if use_pipeline:
         from concurrent.futures import ThreadPoolExecutor
         executor = ThreadPoolExecutor(max_workers=1)
-        futures[todo[0]] = executor.submit(solve_chunk, todo[0])
+
+        def submit_chunk(ci):
+            # A pool thread starts with an EMPTY contextvars context:
+            # without explicit propagation the worker's spans/syncs
+            # would land in the process root trace instead of the
+            # caller's ambient RunTrace. Copying the submitter's
+            # context makes double-buffered chunks SIBLING spans of
+            # the same trace (tests/test_observability.py pins this).
+            return executor.submit(
+                contextvars.copy_context().run, solve_chunk, ci)
+
+        futures[todo[0]] = submit_chunk(todo[0])
 
     parts: list[dict] = []
     try:
@@ -205,8 +219,7 @@ def chunked_sweep_steady_state(spec, conds, *, chunk: int = 4096,
             if executor is not None:
                 nxt = todo.index(ci) + 1
                 if nxt < len(todo):
-                    futures[todo[nxt]] = executor.submit(
-                        solve_chunk, todo[nxt])
+                    futures[todo[nxt]] = submit_chunk(todo[nxt])
                 out, events = futures.pop(ci).result()
             else:
                 out, events = solve_chunk(ci)

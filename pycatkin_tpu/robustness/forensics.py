@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs.manifest import run_manifest
+
 # Diagnostic keys lifted verbatim (as python scalars) from a sweep
 # result dict into each lane report, when present.
 _VERDICT_KEYS = ("rate_ok", "pos_ok", "sums_ok")
@@ -114,6 +116,9 @@ def sweep_failure_report(out: dict, conds=None,
         "lanes": [lane_report(out, int(i), conds=conds, events=events)
                   for i in bad[:max_lanes]],
         "events": list(events or []),
+        # Self-describing forensics: the run manifest records what
+        # code/backend/knobs produced the failures being dissected.
+        "manifest": run_manifest(),
     }
     return report
 

@@ -96,9 +96,13 @@ class SweepJournal:
         header = next((r for r in self._records
                        if r.get("kind") == "header"), None)
         if header is None:
+            from ..obs.manifest import run_manifest
+            # The run manifest makes a journal self-describing (what
+            # code/backend/knobs wrote it). Resume ignores unknown
+            # header keys, so old journals stay replayable.
             header = {"kind": "header", "version": _VERSION,
                       "fingerprint": fingerprint, "n_lanes": n_lanes,
-                      "chunk": chunk}
+                      "chunk": chunk, "manifest": run_manifest()}
             append_json_line(self.manifest_path, header)
             self._records.append(header)
         elif fingerprint is not None and \

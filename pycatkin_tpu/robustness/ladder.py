@@ -29,6 +29,7 @@ import sys
 import time
 from dataclasses import dataclass
 
+from ..obs import metrics as _metrics
 from ..utils import profiling
 from ..utils.retry import call_with_backend_retry
 
@@ -79,6 +80,11 @@ def record_quarantine(lanes, *, label: str = "quarantine:sweep",
     if events is not None:
         events.append(ev)
     profiling.record_event("degradation", **ev)
+    _metrics.counter("pycatkin_ladder_rung_total",
+                     "degradation-ladder rungs fired").inc(
+                         rung="quarantine")
+    _metrics.counter("pycatkin_quarantined_lanes_total",
+                     "lanes NaN-quarantined by the sweep").inc(len(lanes))
     print(f"degradation[{label}]: quarantine: {ev['detail']}",
           file=sys.stderr, flush=True)
     return ev
@@ -136,6 +142,8 @@ def run_chunk_with_ladder(run, *, label: str,
         ev = {"label": label, "rung": rung, "detail": detail}
         events.append(ev)
         profiling.record_event("degradation", **ev)
+        _metrics.counter("pycatkin_ladder_rung_total",
+                         "degradation-ladder rungs fired").inc(rung=rung)
         print(f"degradation[{label}]: {rung}: {detail}",
               file=sys.stderr, flush=True)
 

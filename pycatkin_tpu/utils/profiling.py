@@ -28,6 +28,9 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _obs
+
 
 # ---------------------------------------------------------------------
 # Structured diagnostics event log. The degradation ladder
@@ -36,28 +39,29 @@ import numpy as np
 # fallback, salvage), so a run that limped home carries machine-
 # readable evidence of HOW -- drivers fold drain_events() into their
 # end-of-run reports instead of scraping stderr.
-_EVENTS: list = []
+#
+# Storage is run-scoped since the obs subsystem landed: events go to
+# the AMBIENT RunTrace (pycatkin_tpu.obs.trace) -- the process root
+# trace when no ``obs.run_trace()`` context is open, which is exactly
+# the old process-global behavior, so no legacy call site changes.
 
 
 def record_event(kind: str, **fields) -> dict:
     """Append one structured diagnostics event ({'kind': kind, 't':
-    monotonic seconds, **fields}) and return it."""
-    ev = {"kind": str(kind), "t": round(time.monotonic(), 3), **fields}
-    _EVENTS.append(ev)
-    return ev
+    monotonic seconds, **fields}) to the ambient trace and return it."""
+    return _obs.current_trace().record(kind, **fields)
 
 
 def peek_events(kind: str | None = None) -> list:
-    """Events recorded so far (optionally filtered by kind), without
-    clearing them."""
-    return [e for e in _EVENTS if kind is None or e["kind"] == kind]
+    """The ambient trace's events recorded so far (optionally filtered
+    by kind), without clearing them."""
+    return _obs.current_trace().peek(kind)
 
 
 def drain_events() -> list:
-    """Return AND clear the recorded events (end-of-run report hook)."""
-    out = list(_EVENTS)
-    _EVENTS.clear()
-    return out
+    """Return AND clear the ambient trace's events (end-of-run report
+    hook)."""
+    return _obs.current_trace().drain()
 
 
 @contextmanager
@@ -69,16 +73,13 @@ def span(label: str, **fields):
 
     Records ONE ``{"kind": "span", "label": label, "dur": seconds}``
     event on exit (exceptions included -- a span that died still shows
-    how long it ran). Spans are the variance-forensics primitive:
-    bench.py diffs per-trial span events to attribute slow-trial
-    outliers to a named region (dispatch, rescue pass, tail sync,
-    in-band compile) instead of guessing from total walls."""
-    t0 = time.perf_counter()
-    try:
+    how long it ran), extended with span/parent ids so the obs
+    exporters can rebuild the tree. Spans are the variance-forensics
+    primitive: bench.py diffs per-trial span events to attribute
+    slow-trial outliers to a named region (dispatch, rescue pass, tail
+    sync, in-band compile) instead of guessing from total walls."""
+    with _obs.trace_span(label, **fields):
         yield
-    finally:
-        record_event("span", label=str(label),
-                     dur=round(time.perf_counter() - t0, 6), **fields)
 
 
 # ---------------------------------------------------------------------
@@ -110,6 +111,13 @@ def host_sync(value, label: str = ""):
     with _SYNC_LOCK:
         _SYNC_COUNT += 1
         _SYNC_LABELS.append(label)
+    # Run-scoped attribution rides alongside the process-wide counter:
+    # the ambient trace counts the sync for its own sync_budget and
+    # records a "sync" instant event (label + enclosing span) so the
+    # exported trace reproduces the budget labels.
+    _obs.note_sync(label)
+    _metrics.counter("pycatkin_host_syncs_total",
+                     "counted blocking device->host syncs").inc()
     if isinstance(value, (tuple, list, dict)):
         import jax
         return jax.tree_util.tree_map(np.asarray, jax.device_get(value))
@@ -146,19 +154,27 @@ def sync_budget():
             sweep_steady_state(...)
         assert b.count <= 3
 
-    Concurrent syncs from other threads land in the same process-wide
-    counter (the measurement is a budget, not an attribution)."""
+    Measured against the AMBIENT trace's per-trace counters, so the
+    budget is a real attribution: threads syncing under their own
+    ``obs.run_trace()`` contexts no longer pollute a foreign budget
+    (the concurrency bug the process-global counter had). Without an
+    open trace this reads the process root trace, which in a
+    single-threaded program is identical to the historical
+    process-wide measurement."""
     class _Budget:
         count = 0
         labels: list = []
     b = _Budget()
-    start = _SYNC_COUNT
-    start_len = len(_SYNC_LABELS)
+    tr = _obs.current_trace()
+    with tr.lock:
+        start = tr.sync_count
+        start_len = len(tr.sync_labels)
     try:
         yield b
     finally:
-        b.count = _SYNC_COUNT - start
-        b.labels = _SYNC_LABELS[start_len:]
+        with tr.lock:
+            b.count = tr.sync_count - start
+            b.labels = list(tr.sync_labels[start_len:])
 
 
 def checksum_fence():

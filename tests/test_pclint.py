@@ -30,6 +30,7 @@ from pycatkin_tpu.lint.abi_capture import (SPEC_ARRAY_FIELDS,
 from pycatkin_tpu.lint.core import Finding, checkers_for, lint_file, run_lint
 from pycatkin_tpu.lint.dtype import DtypeChecker
 from pycatkin_tpu.lint.env_registry import EnvRegistryChecker
+from pycatkin_tpu.lint.event_kinds import EventKindChecker
 from pycatkin_tpu.lint.fault_sites import FaultSiteChecker
 from pycatkin_tpu.lint.host_sync import HostSyncChecker, collect_syncs
 from pycatkin_tpu.lint.hotpath import (HOT_FUNCTIONS, HOT_PATH_FILES,
@@ -59,6 +60,15 @@ def _fault_checker(tmp_path):
     doc.write_text("Known sites: `fixture:documented`.\n",
                    encoding="utf-8")
     return FaultSiteChecker(doc_path=str(doc))
+
+
+def _event_checker(tmp_path):
+    """PCL008 against a doc documenting only `span` and
+    `degradation`."""
+    doc = tmp_path / "failure_model.md"
+    doc.write_text("Known kinds: `span`, `degradation`.\n",
+                   encoding="utf-8")
+    return EventKindChecker(doc_path=str(doc))
 
 
 # ---------------------------------------------------------------- PCL001
@@ -137,6 +147,32 @@ def test_fault_site_fixture(tmp_path):
     assert labels == ["fixture:rescue[<i>]", "fixture:undocumented"]
     assert len(inline(findings)) == 1
     assert all("fixture:documented" not in f.message for f in findings)
+
+
+# ---------------------------------------------------------------- PCL008
+
+def test_event_kind_fixture(tmp_path):
+    findings = lint_file(_event_checker(tmp_path),
+                         fx("event_kinds_legacy.py"))
+    act = active(findings)
+    kinds = sorted(f.message.split("`")[1] for f in act)
+    # first-positional AND kind= spellings both detected; the
+    # documented kind, the dynamic kind and the inline-disabled kind
+    # all stay silent.
+    assert kinds == ["checkpoint", "degredation"]
+    assert len(inline(findings)) == 1
+    assert all("`degradation`" not in f.message for f in findings)
+
+
+def test_event_kind_registry_matches_tree(tmp_path):
+    """Every kind recorded by the package is documented in the REAL
+    doc -- the in-tree proof that the registry is closed (the repo
+    gate below covers this too, but this names the rule)."""
+    from pycatkin_tpu.lint import lint_repo
+    findings = lint_repo(rules=["PCL008"])
+    assert findings == [], [f.message for f in findings]
+    assert EventKindChecker().documented() >= {
+        "span", "sync", "degradation", "rescue", "retry"}
 
 
 # ---------------------------------------------------------------- PCL003
@@ -246,6 +282,7 @@ _FIXTURE_MATRIX = [
     ("PCL005", lambda tmp: DtypeChecker(), "dtype_legacy.py"),
     ("PCL006", lambda tmp: EnvRegistryChecker(), "env_legacy.py"),
     ("PCL007", lambda tmp: AbiCaptureChecker(), "abi_capture_legacy.py"),
+    ("PCL008", _event_checker, "event_kinds_legacy.py"),
 ]
 
 

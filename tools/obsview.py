@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""obsview: summarize a pycatkin Chrome trace file.
+
+Renders the span tree of a trace written by ``bench.py --trace DIR``
+(or any :func:`pycatkin_tpu.obs.write_chrome_trace` output) as an
+indented table with per-span total/self times, a per-label summary,
+and the top-N slowest spans. All analysis lives in
+:mod:`pycatkin_tpu.obs.export` so bench.py's outlier attribution and
+this CLI can never disagree.
+
+Usage::
+
+    python tools/obsview.py RUN.trace.json [--top N]
+    python tools/obsview.py --selftest [--sweep]
+
+``--selftest`` is the ``make obs-check`` CI lane: it round-trips a
+programmatic trace through the Chrome exporter, verifies parenting,
+sync-label fidelity and outlier attribution, and lints the Prometheus
+exposition of a populated metrics registry. With ``--sweep`` it
+additionally runs a tiny synthetic sweep (8 lanes, CPU-friendly) under
+a run trace and asserts the exported trace carries the counted sync
+labels -- including the fused path's ``fused tail bundle``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fail(msg: str) -> int:
+    print(f"obsview: FAIL -- {msg}", file=sys.stderr, flush=True)
+    return 1
+
+
+def selftest(sweep: bool = False) -> int:
+    from pycatkin_tpu.obs import (attribute_outlier, format_span_table,
+                                  load_trace, run_manifest, run_trace,
+                                  span_summary, span_tree,
+                                  write_chrome_trace)
+    from pycatkin_tpu.obs.metrics import (MetricsRegistry,
+                                          validate_prometheus_text)
+    from pycatkin_tpu.utils import profiling
+
+    # 1. Trace round trip: nested spans + a counted "sync" under a
+    #    run-scoped trace, exported and re-parsed.
+    with run_trace("obsview-selftest") as tr:
+        with profiling.span("outer"):
+            with profiling.span("inner"):
+                profiling.host_sync([1.0, 2.0], "selftest sync")
+    with tempfile.TemporaryDirectory(prefix="obsview_") as tmp:
+        path = os.path.join(tmp, "selftest.trace.json")
+        write_chrome_trace(path, tr)
+        obj = load_trace(path)
+    names = [ev.get("name") for ev in obj["traceEvents"]]
+    if "outer" not in names or "inner" not in names:
+        return _fail("exported trace lost its spans")
+    if "selftest sync" not in names:
+        return _fail("exported trace lost its counted sync label")
+    if obj["otherData"]["sync_labels"] != ["selftest sync"]:
+        return _fail("trace metadata sync labels drifted")
+    roots = span_tree(tr.peek("span"))
+    if (len(roots) != 1 or roots[0]["label"] != "outer"
+            or [c["label"] for c in roots[0]["children"]] != ["inner"]):
+        return _fail("span tree parenting broken")
+    if not span_summary(obj["traceEvents"]):
+        return _fail("span summary empty for a trace with spans")
+    print(format_span_table(obj["traceEvents"], top=3))
+
+    # 2. Outlier attribution (the bench.py variance gate).
+    out = attribute_outlier(
+        [{"a": 1.0, "b": 0.1}, {"a": 1.0, "b": 0.1},
+         {"a": 1.0, "b": 2.1}],
+        [1.1, 1.1, 3.1])
+    if not out or out["label"] != "b":
+        return _fail(f"outlier attribution wrong: {out}")
+
+    # 3. Prometheus exposition lint on a populated scratch registry.
+    reg = MetricsRegistry()
+    reg.counter("obsview_selftest_total", "selftest counter").inc(
+        3, kind="demo")
+    reg.gauge("obsview_selftest_gauge").set(1.5)
+    h = reg.histogram("obsview_selftest_seconds", "selftest histogram")
+    for v in (0.004, 0.2, 7.0):
+        h.observe(v)
+    problems = validate_prometheus_text(reg.prometheus_text())
+    if problems:
+        return _fail("prometheus exposition invalid: "
+                     + "; ".join(problems))
+
+    # ... and on the LIVE process registry (host_sync above fed it).
+    from pycatkin_tpu.obs import metrics as live_metrics
+    problems = validate_prometheus_text(live_metrics.prometheus_text())
+    if problems:
+        return _fail("live prometheus exposition invalid: "
+                     + "; ".join(problems))
+
+    # 4. Manifest sanity.
+    man = run_manifest()
+    if man.get("schema") != "pycatkin-run-manifest/v1":
+        return _fail(f"manifest schema drifted: {man.get('schema')}")
+
+    if sweep:
+        # 5. A real (tiny, CPU-friendly) sweep under a run trace: the
+        #    exported trace must reproduce the counted sync labels --
+        #    on the fused clean path that is exactly one, the packed
+        #    "fused tail bundle".
+        from pycatkin_tpu.models.synthetic import synthetic_system
+        from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                                 sweep_steady_state)
+        sim = synthetic_system(n_species=16, n_reactions=24)
+        conds = broadcast_conditions(sim.conditions(), 8)
+        with run_trace("obsview-sweep") as tr2:
+            with profiling.sync_budget() as budget:
+                sweep_steady_state(sim.spec, conds)
+        with tempfile.TemporaryDirectory(prefix="obsview_") as tmp:
+            path = os.path.join(tmp, "sweep.trace.json")
+            write_chrome_trace(path, tr2)
+            obj = load_trace(path)
+        sync_names = [ev["name"] for ev in obj["traceEvents"]
+                      if ev.get("cat") == "sync"]
+        if sync_names != budget.labels:
+            return _fail(f"sweep trace sync labels {sync_names} != "
+                         f"budget labels {budget.labels}")
+        snap = live_metrics.snapshot()
+        lanes = snap["counters"].get("pycatkin_lanes_solved_total", {})
+        if sum(lanes.values()) < 8:
+            return _fail("lanes-solved counter did not observe the "
+                         "sweep")
+        print(f"obsview: sweep trace OK -- {len(obj['traceEvents'])} "
+              f"events, syncs {sync_names}")
+
+    print("obsview: selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obsview.py",
+        description="span-tree summary of a pycatkin Chrome trace")
+    ap.add_argument("trace", nargs="?", help="trace JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-span count in the summary tail")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the obs-check self-test instead of "
+                         "reading a trace")
+    ap.add_argument("--sweep", action="store_true",
+                    help="with --selftest: also trace a tiny real "
+                         "sweep (compiles a small program)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest(sweep=args.sweep)
+    if not args.trace:
+        ap.error("need a trace file (or --selftest)")
+
+    from pycatkin_tpu.obs import format_span_table, load_trace
+    try:
+        obj = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return _fail(str(e))
+    meta = obj.get("otherData", {})
+    if meta:
+        print(f"trace: {meta.get('trace_name')} "
+              f"(id {meta.get('trace_id')}), "
+              f"{meta.get('sync_count')} counted sync(s): "
+              f"{meta.get('sync_labels')}")
+    print(format_span_table(obj["traceEvents"], top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
