@@ -16,14 +16,20 @@
 # `obs-check` is the observability lane (docs/observability.md):
 # tools/obsview.py --selftest --sweep round-trips a Chrome trace,
 # verifies span parenting + sync-label fidelity against a real traced
-# sweep, and lints the Prometheus metrics exposition.
+# sweep, and lints the Prometheus metrics exposition. `perfwatch` is
+# the perf-regression sentinel (docs/perf_cost_ledger.md): the
+# selftest proves the noise-aware baseline math (injected 2x
+# regression flagged, in-noise wobble not), then --check judges the
+# newest checked-in BENCH_r*.json round against the prior rounds'
+# median +/- MAD baseline and hard-fails on a throughput/MFU
+# regression.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-all lint \
 	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest \
-	obs-check
+	obs-check perfwatch
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -68,3 +74,7 @@ aot-pack-selftest:
 
 obs-check:
 	env JAX_PLATFORMS=cpu python tools/obsview.py --selftest --sweep
+
+perfwatch:
+	env JAX_PLATFORMS=cpu python tools/perfwatch.py --selftest
+	env JAX_PLATFORMS=cpu python tools/perfwatch.py --check

@@ -460,15 +460,26 @@ def main():
     # The attribution itself lives in pycatkin_tpu.obs (shared with
     # tools/obsview.py, so the CLI and the bench can never disagree).
     max_over_median = round(max(walls) / wall, 3)
-    attr = obs.attribute_outlier(trial_spans, walls, threshold=1.1)
-    outlier_span = None
-    if attr:
-        outlier_span = {"label": attr["label"],
-                        "extra_s": attr["extra_s"]}
-        log(f"slow-trial outlier: trial {attr['trial']} "
+    outlier_span = obs.attribute_outlier(
+        trial_spans, walls, threshold=1.1,
+        cost_ledger=obs.ledger_snapshot())
+    if outlier_span:
+        log(f"slow-trial outlier: trial {outlier_span['trial']} "
             f"({max(walls):.3f} s vs median {wall:.3f} s); "
-            f"dominant span: {attr['label']} "
-            f"(+{attr['extra_s']:.3f} s)")
+            f"dominant span: {outlier_span['label']} "
+            f"(+{outlier_span['extra_s']:.3f} s)")
+
+    # Device cost ledger: compile-time FLOPs/bytes per program (XLA's
+    # own cost_analysis, harvested at prewarm) joined with the blocked
+    # dispatch walls accumulated across prewarm + trials. Totals carry
+    # achieved FLOP/s and -- on devices with a measured ceiling -- MFU,
+    # the headline efficiency number tools/perfwatch.py tracks.
+    cost_ledger = obs.ledger_snapshot()
+    lane_tel = last.get("lane_telemetry")
+    lanes = obs.lane_summary(lane_tel) if lane_tel is not None else None
+    if lanes:
+        log(f"lane telemetry: {lanes['strategies']} strategies, "
+            f"iterations median {lanes['iterations']['median']}")
 
     vs_baseline = None
     if have_ref:
@@ -543,7 +554,17 @@ def main():
         "trial_spans": trial_spans,
         "max_over_median": max_over_median,
         "variance_ok": max_over_median < 1.1,
+        # Full attribution dict from obs.attribute_outlier (label,
+        # extra_s, trial, max_over_median, cost-ledger programs).
         "outlier_span": outlier_span,
+        # Per-program device costs + achieved FLOP/s / MFU; "mfu" is
+        # the ledger total, null on backends with no measured ceiling.
+        "cost_ledger": cost_ledger,
+        "mfu": (cost_ledger.get("totals") or {}).get("mfu"),
+        # Per-lane solver telemetry aggregates of the last timed trial
+        # (full [lanes, 4] arrays stay out of the JSON line at 256x256;
+        # use --trace / tools/obsview.py --lanes for the heatmap).
+        "lanes": lanes,
         # Self-describing record: git state, backend, mesh, every set
         # PYCATKIN_* knob, ABI bucket and aot-key version that produced
         # these numbers (pycatkin_tpu.obs.manifest schema).
@@ -580,11 +601,13 @@ def smoke_main():
     """``bench.py --smoke``: the ``make bench-smoke`` CI lane. The
     pclint static-analysis gate followed by an 8x8 sweep with prewarm
     on whatever backend is available (CPU in CI), exiting non-zero on
-    any new lint finding, any crash, OR on a clean sweep spending more
+    any new lint finding, any crash, a clean sweep spending more
     than 2 counted host syncs (the fused single-dispatch tail spends
-    exactly 1) -- the cheap end-to-end canary that the
-    correctness gates and the pipelined executor survive integration,
-    not a throughput record. Prints exactly one JSON line."""
+    exactly 1), a prewarmed program missing its cost-ledger row, or a
+    sweep output missing its per-lane telemetry bundle -- the cheap
+    end-to-end canary that the correctness gates and the pipelined
+    executor survive integration, not a throughput record. Prints
+    exactly one JSON line."""
     global GRID_N
     GRID_N = 8
 
@@ -726,6 +749,32 @@ def smoke_main():
                   and _ctotal("pycatkin_lanes_solved_total") >= n
                   and _ctotal("pycatkin_host_syncs_total") > 0)
 
+    # Cost-ledger gate (ISSUE-9): every program the smoke prewarm
+    # ensured must own a ledger row with nonnegative compile-time
+    # flops/bytes, and the dispatched sweep must have accumulated
+    # blocked wall on at least one row (the dispatch-wall join that
+    # turns costs into achieved FLOP/s).
+    from pycatkin_tpu.obs import lane_summary, ledger_snapshot
+    cost_ledger = ledger_snapshot()
+    led_rows = cost_ledger["programs"]
+    n_costed = sum(1 for r in led_rows.values()
+                   if r.get("flops", -1.0) >= 0.0
+                   and r.get("bytes_accessed", -1.0) >= 0.0)
+    dispatched = any(r.get("dispatches", 0) > 0
+                     and r.get("blocked_wall_s", 0.0) > 0.0
+                     for r in led_rows.values())
+    costs_ok = n_costed >= int(n_prog) and dispatched
+
+    # Per-lane telemetry gate: the sweep output must carry the packed
+    # [lanes, 4] bundle (it rides inside the one counted sync) and the
+    # per-lane histograms must have observed every lane.
+    lane_tel = out.get("lane_telemetry")
+    hists = obs_metrics.snapshot()["histograms"]
+    lane_obs = sum(st["count"] for st in
+                   hists.get("pycatkin_lane_iterations", {}).values())
+    lane_telemetry_ok = (lane_tel is not None and len(lane_tel) == n
+                         and lane_obs >= n)
+
     manifest = run_manifest()
     set_knobs = sorted(k for k in os.environ
                        if k.startswith("PYCATKIN_"))
@@ -760,6 +809,16 @@ def smoke_main():
         "trace_error": trace_err,
         "metrics_ok": metrics_ok,
         "manifest_ok": manifest_ok,
+        "costs_ok": costs_ok,
+        "cost_ledger_programs": len(led_rows),
+        "mfu": (cost_ledger.get("totals") or {}).get("mfu"),
+        "lane_telemetry_ok": lane_telemetry_ok,
+        "lanes": (lane_summary(lane_tel) if lane_tel is not None
+                  else None),
+        # Small enough at 8x8 to ship whole; tools/obsview.py --lanes
+        # renders this JSON line directly.
+        "lane_telemetry": (np.asarray(lane_tel).tolist()
+                           if lane_tel is not None else None),
         "manifest": manifest,
     }
     print(json.dumps(result))
@@ -776,6 +835,16 @@ def smoke_main():
         log(f"bench-smoke: FAIL -- manifest env gate: manifest lists "
             f"{sorted(manifest.get('env') or {})}, process has "
             f"{set_knobs}")
+        return 1
+    if not costs_ok:
+        log(f"bench-smoke: FAIL -- cost ledger gate: {n_costed} of "
+            f"{int(n_prog)} prewarmed program(s) carry flops/bytes, "
+            f"dispatch wall recorded: {dispatched}")
+        return 1
+    if not lane_telemetry_ok:
+        log(f"bench-smoke: FAIL -- lane telemetry gate: bundle "
+            f"{'missing' if lane_tel is None else len(lane_tel)}, "
+            f"histogram observed {lane_obs}/{n} lanes")
         return 1
     if not abi_zero_compile_ok:
         log(f"bench-smoke: FAIL -- second mechanism in the warm ABI "

@@ -284,14 +284,15 @@ def steady_state(spec: ModelSpec, cond: Conditions,
         x0 = jnp.where(use_x0, jnp.asarray(x0), y_base[dyn])
     groups_dyn = jnp.asarray(spec.groups)[:, dyn]
     (x, success, res, iters, attempts, rate_ok, pos_ok, sums_ok,
-     dt_exit) = newton.solve_steady(
+     dt_exit, chords) = newton.solve_steady(
         fscale, jac, jnp.asarray(x0), groups_dyn, opts, key=key,
         strategy=strategy)
     y_full = y_base.at[dyn].set(x)
     return SteadyStateResults(x=y_full, success=success, residual=res,
                               iterations=iters, attempts=attempts,
                               rate_ok=rate_ok, pos_ok=pos_ok,
-                              sums_ok=sums_ok, dt_exit=dt_exit)
+                              sums_ok=sums_ok, dt_exit=dt_exit,
+                              chords=chords)
 
 
 def steady_jacobian(spec: ModelSpec, cond: Conditions, x_dyn):

@@ -22,6 +22,11 @@ PCL007    abi-spec-capture  no spec.<array> numpy reads inside
                             program-builder closures in
                             parallel/batch.py (use the bound
                             TracedSpec; docs/mechanism_abi.md)
+PCL008    event-kinds       every record_event kind documented in
+                            docs/failure_model.md
+PCL009    metric-names      every metric name emitted via obs.metrics
+                            documented in the docs/observability.md
+                            metrics catalog
 ========  ================  =============================================
 
 Suppressions: inline ``# pclint: disable=<rule> -- <reason>`` (any line

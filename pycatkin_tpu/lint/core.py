@@ -203,7 +203,8 @@ def all_checkers() -> list[Checker]:
     the built-in checker modules on first use so plain
     ``import pycatkin_tpu.lint.core`` stays dependency-free."""
     from . import (abi_capture, dtype, env_registry,  # noqa: F401
-                   event_kinds, fault_sites, host_sync, purity, tracer)
+                   event_kinds, fault_sites, host_sync, metric_names,
+                   purity, tracer)
     return [_REGISTRY[rule]() for rule in sorted(_REGISTRY)]
 
 

@@ -1,0 +1,97 @@
+"""PCL009 metric-names: every metric emitted via ``obs.metrics`` is
+documented in the docs/observability.md metrics catalog.
+
+Prometheus-style metrics are addressed by name: dashboards, the
+perfwatch sentinel and the smoke gates all key on the literal strings
+handed to ``counter(...)`` / ``gauge(...)`` / ``histogram(...)``. A
+metric emitted under a name the catalog does not list is telemetry
+nobody will find (and a renamed metric silently orphans every consumer
+of the old name). The name vocabulary is therefore a closed registry:
+the metrics catalog table of docs/observability.md. An instrument call
+in the package whose literal name is not backticked there is a
+finding; dynamic (non-literal) names cannot be statically checked and
+are skipped, as are scratch registries outside ``pycatkin_tpu/``
+(tests and tools may mint throwaway names).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, SourceFile, register
+
+DOC_RELPATH = os.path.join("docs", "observability.md")
+
+# Callees whose first positional (or ``name=``) argument is a metric
+# name: the module-level get-or-create entry points of obs.metrics and
+# the same-named MetricsRegistry methods they delegate to.
+METRIC_FUNCS = frozenset({"counter", "gauge", "histogram"})
+
+
+def metric_names(tree) -> list:
+    """(name, node) pairs for every literal-name instrument call in
+    one module's AST."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = getattr(func, "id", None) or getattr(func, "attr", "")
+        if fname not in METRIC_FUNCS:
+            continue
+        name_node = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_node = kw.value
+        if isinstance(name_node, ast.Constant) \
+                and isinstance(name_node.value, str):
+            out.append((name_node.value, node))
+    return out
+
+
+def documented_names(doc_path: str) -> set:
+    """Every backticked token in the observability doc (the metrics
+    catalog rows; sharing the token pool with the doc's other backticks
+    is harmless -- metric names are namespaced ``pycatkin_*``)."""
+    with open(doc_path, encoding="utf-8") as fh:
+        return set(re.findall(r"`([^`\n]+)`", fh.read()))
+
+
+@register
+class MetricNameChecker(Checker):
+    rule = "PCL009"
+    name = "metric-names"
+    description = ("metric name not documented in the "
+                   "docs/observability.md metrics catalog")
+    scope = ("pycatkin_tpu/",)
+
+    def __init__(self, doc_path: Optional[str] = None):
+        super().__init__()
+        self._doc_path = doc_path
+        self._documented: Optional[set] = None
+
+    @property
+    def doc_path(self) -> str:
+        return self._doc_path or os.path.join(self.root, DOC_RELPATH)
+
+    def documented(self) -> set:
+        if self._documented is None:
+            self._documented = documented_names(self.doc_path)
+        return self._documented
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        names = metric_names(src.tree)
+        if not names:
+            return
+        documented = self.documented()
+        rel_doc = DOC_RELPATH.replace(os.sep, "/")
+        for mname, node in names:
+            if mname in documented:
+                continue
+            yield self.finding(
+                src, node,
+                f"undocumented metric `{mname}` -- add it, backticked, "
+                f"to the metrics catalog in {rel_doc}")

@@ -14,14 +14,24 @@ tooling (``tools/obsview.py``, CI lanes) stays cheap:
   as a JSON snapshot or Prometheus text exposition.
 - :mod:`pycatkin_tpu.obs.export` / :mod:`pycatkin_tpu.obs.manifest` --
   Chrome ``trace_event`` JSON (Perfetto-loadable), span-tree summaries
-  shared by bench.py and ``tools/obsview.py``, and the self-describing
-  run manifest attached to bench JSON, journal headers and forensics
-  reports.
+  and per-lane telemetry heatmaps shared by bench.py and
+  ``tools/obsview.py``, and the self-describing run manifest attached
+  to bench JSON, journal headers and forensics reports.
+- :mod:`pycatkin_tpu.obs.costs` / :mod:`pycatkin_tpu.obs.history` --
+  the device cost ledger (compile-time FLOP/byte truth per program,
+  joined with dispatch walls into per-program MFU) and the rolling
+  bench history + noise-aware regression flagging behind
+  ``tools/perfwatch.py``.
 """
 
+from .costs import (CostLedger, device_peak,  # noqa: F401
+                    flops_per_iteration, harvest_cost, ledger_snapshot)
 from .export import (attribute_outlier, chrome_trace,  # noqa: F401
-                     format_span_table, load_trace, span_summary,
-                     span_tree, top_spans, write_chrome_trace)
+                     format_lane_heatmap, format_span_table,
+                     lane_summary, load_trace, span_summary, span_tree,
+                     top_spans, write_chrome_trace)
+from .history import (baseline, extract_metrics,  # noqa: F401
+                      flag_regressions, load_history)
 from .manifest import run_manifest  # noqa: F401
 from .metrics import (counter, default_registry, gauge,  # noqa: F401
                       histogram, prometheus_text,
@@ -34,7 +44,11 @@ __all__ = [
     "RunTrace", "run_trace", "current_trace", "current_span_id",
     "root_trace", "chrome_trace", "write_chrome_trace", "load_trace",
     "span_tree", "span_summary", "top_spans", "format_span_table",
-    "attribute_outlier", "run_manifest", "counter", "gauge",
+    "attribute_outlier", "lane_summary", "format_lane_heatmap",
+    "run_manifest", "counter", "gauge",
     "histogram", "default_registry", "metrics_snapshot",
     "prometheus_text", "validate_prometheus_text",
+    "CostLedger", "harvest_cost", "ledger_snapshot", "device_peak",
+    "flops_per_iteration",
+    "load_history", "baseline", "flag_regressions", "extract_metrics",
 ]

@@ -1,0 +1,273 @@
+"""Device cost ledger: compile-time FLOP/byte/memory truth per program.
+
+Host-side wall-clock spans (obs/trace.py) can say a region was slow but
+not whether the device did more work or did the same work worse. This
+module records, for every program in the zoo, the DEVICE-side cost that
+XLA itself reports at compile time -- ``compiled.cost_analysis()``
+(FLOPs, bytes accessed) and ``compiled.memory_analysis()`` (peak temp /
+output / argument allocation) -- keyed by the same aot-key /
+ABI-bucket program key the executable registry and AOT cache use, and
+joins it with per-dispatch blocked-wall timings so achieved FLOP/s and
+MFU fall out per program instead of per eyeball (the accounting
+``tools/exp_mfu.py`` / ``tools/exp_roofline.py`` used to hand-roll).
+
+Three producers feed the ledger (``parallel/compile_pool.py``):
+
+- a fresh ``.lower().compile()`` harvests the analyses directly off the
+  compiled executable (``source="compiled"``);
+- an AOT cache hit replays the cost dict recorded in the cache entry at
+  save time (``source="cache"``) -- the analyses are NOT recomputable
+  from a deserialized executable on every backend, so they ride in the
+  entry;
+- a pack import carries the same dict through the pack manifest
+  (``_entry_meta``), so a worker booted from a shipped pack still knows
+  what its programs cost (``source="pack"``).
+
+One consumer feeds timings: ``parallel/batch._registered_call`` notes
+the blocked wall of every registered-executable dispatch
+(:func:`note_dispatch`). ``snapshot()`` then derives achieved FLOP/s
+and MFU against :data:`DEVICE_PEAKS` (the measured ceilings from
+docs/perf_cost_ledger.md) for bench JSON, the run manifest and
+``tools/perfwatch.py``.
+
+No JAX imports at module scope -- the ledger must stay importable from
+lint/CI tooling; :func:`harvest_cost` only touches the compiled object
+it is handed.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# Measured device ceilings (flop/s, bytes/s) for the MFU denominator,
+# keyed by a lowercase substring of ``jax.devices()[0].device_kind``.
+# The TPU v5e numbers are the microbenchmarked rooflines from
+# docs/perf_cost_ledger.md (historical record: docs/perf_mfu.md): this
+# workload is float64-EMULATED on v5e, so the honest compute ceiling is
+# the measured f64-emulation FMA roofline (1.519e11 flop/s), not the
+# 1.97e14 bf16 marketing peak; the byte ceiling is the measured HBM
+# stream rate. Unknown device kinds (CPU included) get no peak and an
+# MFU of None -- a fabricated denominator is worse than no MFU.
+DEVICE_PEAKS = {
+    "v5 lite": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
+    "v5e": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
+    "v5p": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
+}
+
+
+def device_peak(device_kind) -> dict | None:
+    """The measured ``{"flops_per_s", "bytes_per_s"}`` ceiling for a
+    device kind, or None when no honest ceiling is known."""
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    for key, peak in DEVICE_PEAKS.items():
+        if key in kind:
+            return dict(peak)
+    return None
+
+
+def flops_per_iteration(n_s: int, n_r: int, n_dyn: int,
+                        n_reac_cols: int, chords: int = 0) -> float:
+    """Analytic useful-FLOP model of ONE PTC Newton iteration (promoted
+    from tools/exp_mfu.py so the framework and the experiment scripts
+    share one formula): RHS evaluation + dense Jacobian (n_dyn RHS-cost
+    columns) + LU solve + ``chords`` chord re-solves. This is the
+    NUMERATOR of the useful-MFU metric -- XLA's cost_analysis counts
+    every executed flop including padding; this counts the flops the
+    algorithm needed."""
+    R = 2.0 * n_r * n_reac_cols + 2.0 * 2.0 * n_s * n_r
+    jac = n_dyn * R
+    solve = (2.0 * n_dyn ** 3 if n_dyn <= 48
+             else (2.0 / 3.0) * n_dyn ** 3)
+    chord = chords * (2.0 * n_dyn ** 2 + R)
+    return R + jac + solve + chord + 10.0 * n_dyn
+
+
+def _as_float(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(f):
+        return None
+    return f
+
+
+def harvest_cost(compiled) -> dict | None:
+    """XLA's own cost/memory analyses of one compiled executable, as a
+    plain JSON-able dict, or None when the backend exposes neither.
+
+    Defensive by design: ``cost_analysis()`` returns a dict on current
+    jax and a list-of-dicts on older releases, ``memory_analysis()`` is
+    absent on some backends, and a deserialized AOT executable may
+    refuse both -- every probe degrades to missing keys, never raises.
+    Negative or non-finite values (sentinel artifacts of some backends)
+    are dropped.
+    """
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            flops = _as_float(ca.get("flops"))
+            if flops is not None and flops >= 0:
+                out["flops"] = flops
+            by = _as_float(ca.get("bytes accessed"))
+            if by is not None and by >= 0:
+                out["bytes_accessed"] = by
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, key in (("temp_size_in_bytes", "temp_bytes"),
+                           ("output_size_in_bytes", "output_bytes"),
+                           ("argument_size_in_bytes", "argument_bytes"),
+                           ("generated_code_size_in_bytes",
+                            "code_bytes")):
+            v = _as_float(getattr(ma, field, None))
+            if v is not None and v >= 0:
+                out[key] = v
+    except Exception:
+        pass
+    return out or None
+
+
+class CostLedger:
+    """Thread-safe per-program cost rows, keyed by program key.
+
+    A row is ``{kind, label, source, flops, bytes_accessed, temp_bytes,
+    output_bytes, argument_bytes, code_bytes, dispatches,
+    blocked_wall_s}`` with absent analyses simply missing. ``record``
+    merges (cost fields only fill gaps -- the compile-time harvest wins
+    over a cache replay of itself), ``note_dispatch`` accumulates the
+    blocked wall, ``snapshot`` derives achieved FLOP/s and MFU.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: dict = {}
+
+    def record(self, key: str, kind: str = None, label: str = None,
+               cost: dict = None, source: str = "compiled"):
+        """Merge one program's identity + cost dict into its row."""
+        with self._lock:
+            row = self._rows.setdefault(
+                str(key), {"dispatches": 0, "blocked_wall_s": 0.0})
+            if kind is not None:
+                row.setdefault("kind", str(kind))
+            if label is not None:
+                row.setdefault("label", str(label))
+            if cost:
+                for k, v in cost.items():
+                    f = _as_float(v)
+                    if f is not None and k not in row:
+                        row[k] = f
+                row.setdefault("source", str(source))
+
+    def note_dispatch(self, key: str, wall_s: float, count: int = 1):
+        """Accumulate one dispatch's blocked wall onto a program's row
+        (creates a cost-less row for programs nobody harvested, so the
+        dispatch count is never lost). ``count=0`` folds extra blocked
+        wall -- e.g. the materialization that follows an async dispatch
+        -- onto a dispatch that was already counted."""
+        with self._lock:
+            row = self._rows.setdefault(
+                str(key), {"dispatches": 0, "blocked_wall_s": 0.0})
+            row["dispatches"] += int(count)
+            row["blocked_wall_s"] += float(wall_s)
+
+    def row(self, key: str) -> dict | None:
+        with self._lock:
+            row = self._rows.get(str(key))
+            return dict(row) if row is not None else None
+
+    def keys(self) -> list:
+        with self._lock:
+            return sorted(self._rows)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def snapshot(self, device_kind: str = None) -> dict:
+        """JSON-able ``{"programs": {key: row}, "totals": {...},
+        "peak": {...}|None}`` with derived per-program
+        ``achieved_flops_per_s`` and ``mfu`` (and byte-side
+        ``achieved_bytes_per_s`` / ``hbm_util``) wherever a row has
+        both a harvested cost and a nonzero blocked wall. MFU is
+        against :func:`device_peak`; None when no ceiling is known
+        (CPU) -- absent, not fabricated."""
+        peak = device_peak(device_kind)
+        with self._lock:
+            rows = {k: dict(v) for k, v in self._rows.items()}
+        tot_flops = tot_wall = 0.0
+        for row in rows.values():
+            wall = row.get("blocked_wall_s", 0.0)
+            n = row.get("dispatches", 0)
+            flops = row.get("flops")
+            by = row.get("bytes_accessed")
+            if wall > 0 and n > 0:
+                if flops is not None:
+                    row["achieved_flops_per_s"] = flops * n / wall
+                    tot_flops += flops * n
+                    tot_wall += wall
+                    if peak:
+                        row["mfu"] = (row["achieved_flops_per_s"]
+                                      / peak["flops_per_s"])
+                if by is not None:
+                    row["achieved_bytes_per_s"] = by * n / wall
+                    if peak:
+                        row["hbm_util"] = (row["achieved_bytes_per_s"]
+                                           / peak["bytes_per_s"])
+        totals = {"programs": len(rows),
+                  "dispatches": sum(r.get("dispatches", 0)
+                                    for r in rows.values()),
+                  "blocked_wall_s": round(sum(
+                      r.get("blocked_wall_s", 0.0)
+                      for r in rows.values()), 6)}
+        if tot_wall > 0:
+            totals["achieved_flops_per_s"] = tot_flops / tot_wall
+            if peak:
+                totals["mfu"] = (tot_flops / tot_wall
+                                 / peak["flops_per_s"])
+        return {"programs": rows, "totals": totals, "peak": peak}
+
+    def reset(self):
+        with self._lock:
+            self._rows.clear()
+
+
+default_ledger = CostLedger()
+
+
+def record(key: str, kind: str = None, label: str = None,
+           cost: dict = None, source: str = "compiled"):
+    default_ledger.record(key, kind=kind, label=label, cost=cost,
+                          source=source)
+
+
+def note_dispatch(key: str, wall_s: float, count: int = 1):
+    default_ledger.note_dispatch(key, wall_s, count=count)
+
+
+def ledger_snapshot(device_kind: str = None) -> dict:
+    """Snapshot of the process-wide default ledger. When
+    ``device_kind`` is None and JAX is already initialized, the live
+    device kind is probed (never initializing a backend of its own --
+    same rule as the run manifest)."""
+    if device_kind is None:
+        import sys
+        if "jax" in sys.modules:
+            try:
+                import jax
+                device_kind = jax.devices()[0].device_kind
+            except Exception:
+                device_kind = None
+    return default_ledger.snapshot(device_kind)
+
+
+def reset():
+    default_ledger.reset()

@@ -190,7 +190,7 @@ def format_span_table(events, top: int = 0) -> str:
 
 
 def attribute_outlier(trial_spans: list, walls: list,
-                      threshold: float = 1.1):
+                      threshold: float = 1.1, cost_ledger: dict = None):
     """Name the span that dominates a slow-trial outlier.
 
     ``trial_spans`` is one ``{label: total_seconds}`` dict per trial,
@@ -199,6 +199,12 @@ def attribute_outlier(trial_spans: list, walls: list,
     "extra_s", "trial", "max_over_median"}`` for the span whose total
     grew the most between the median and slowest trials (bench.py's
     variance-forensics gate); else None.
+
+    ``cost_ledger`` (a :meth:`CostLedger.snapshot` dict, optional)
+    joins device-side truth onto the host-side verdict: the attribution
+    gains a ``"programs"`` list naming the ledger rows with the most
+    blocked wall, so a slow trial reads "the fused sweep program, 3
+    dispatches, 41 ms blocked, MFU 0.31" instead of just a span label.
     """
     if not walls or len(walls) != len(trial_spans):
         return None
@@ -216,5 +222,104 @@ def attribute_outlier(trial_spans: list, walls: list,
     if not deltas:
         return None
     dom = max(deltas, key=lambda k: deltas[k])
-    return {"label": dom, "extra_s": round(deltas[dom], 3),
-            "trial": slow_i, "max_over_median": max_over_median}
+    out = {"label": dom, "extra_s": round(deltas[dom], 3),
+           "trial": slow_i, "max_over_median": max_over_median}
+    progs = (cost_ledger or {}).get("programs") or {}
+    if progs:
+        ranked = sorted(progs.items(),
+                        key=lambda kv: -kv[1].get("blocked_wall_s", 0.0))
+        out["programs"] = [
+            {"key": k,
+             **{f: row[f] for f in ("kind", "label", "dispatches",
+                                    "blocked_wall_s", "flops",
+                                    "achieved_flops_per_s", "mfu")
+                if f in row}}
+            for k, row in ranked[:3]]
+    return out
+
+
+# -- per-lane solver telemetry (packed [lanes, 4] int rows) -----------
+
+# Mirrors solvers.newton.STRATEGY_CODES -- duplicated here because this
+# module must stay importable without JAX (lint/CI tooling); the lane
+# telemetry test asserts the two stay in sync.
+STRATEGY_NAMES = ("clean", "polish", "ptc", "lm", "unseeded", "demote",
+                  "quarantine")
+_STRATEGY_GLYPHS = ".Ptlud#"    # one glyph per code; '#' = quarantine
+
+
+def _lane_rows(lane_telemetry) -> list:
+    """Normalize a packed ``[lanes, 4]`` telemetry array (numpy array
+    or nested lists: iterations, chords, residual decade, strategy
+    code) into plain int tuples."""
+    rows = []
+    for row in lane_telemetry:
+        vals = [int(v) for v in row]
+        if len(vals) != 4:
+            raise ValueError(
+                f"lane telemetry row has {len(vals)} fields, expected 4 "
+                f"(iterations, chords, residual_decade, strategy)")
+        rows.append(tuple(vals))
+    return rows
+
+
+def lane_summary(lane_telemetry) -> dict:
+    """Aggregate one sweep's packed per-lane telemetry into JSON:
+    iteration/chord totals and extrema, the residual-decade histogram,
+    and per-strategy lane counts (``strategies`` maps name -> count,
+    zero-count strategies omitted)."""
+    rows = _lane_rows(lane_telemetry)
+    if not rows:
+        return {"lanes": 0}
+    its = sorted(r[0] for r in rows)
+    chs = [r[1] for r in rows]
+    decades: dict = {}
+    strategies: dict = {}
+    for _, _, dec, strat in rows:
+        decades[dec] = decades.get(dec, 0) + 1
+        name = (STRATEGY_NAMES[strat] if 0 <= strat < len(STRATEGY_NAMES)
+                else f"code{strat}")
+        strategies[name] = strategies.get(name, 0) + 1
+    return {
+        "lanes": len(rows),
+        "iterations": {"min": its[0], "median": its[len(its) // 2],
+                       "max": its[-1], "total": sum(its)},
+        "chords_total": sum(chs),
+        "chords_max": max(chs),
+        "residual_decades": {str(k): decades[k]
+                             for k in sorted(decades)},
+        "strategies": strategies,
+    }
+
+
+def format_lane_heatmap(lane_telemetry, width: int = 64) -> str:
+    """Human rendering of per-lane telemetry: a lane grid (one glyph
+    per lane by rescue strategy, ``.`` = clean through ``#`` =
+    quarantined), then the :func:`lane_summary` aggregates. The grid is
+    row-major in lane order, ``width`` lanes per row -- adjacent lanes
+    in the sweep grid stay adjacent on screen, so a bad corner of the
+    condition grid shows up as a bad corner of the heatmap."""
+    rows = _lane_rows(lane_telemetry)
+    lines = [f"lane strategy heatmap ({len(rows)} lanes; "
+             + " ".join(f"{g}={n}" for g, n
+                        in zip(_STRATEGY_GLYPHS, STRATEGY_NAMES)) + "):"]
+    for start in range(0, len(rows), max(1, width)):
+        chunk = rows[start:start + max(1, width)]
+        glyphs = "".join(
+            _STRATEGY_GLYPHS[r[3]] if 0 <= r[3] < len(_STRATEGY_GLYPHS)
+            else "?" for r in chunk)
+        lines.append(f"  {start:>6d}  {glyphs}")
+    s = lane_summary(rows)
+    if s.get("lanes"):
+        it = s["iterations"]
+        lines.append(f"  iterations min/med/max {it['min']}/"
+                     f"{it['median']}/{it['max']}  total {it['total']}")
+        lines.append(f"  chords total {s['chords_total']}  "
+                     f"max {s['chords_max']}")
+        lines.append("  residual decades  "
+                     + "  ".join(f"1e{k}:{v}" for k, v
+                                 in s["residual_decades"].items()))
+        lines.append("  strategies  "
+                     + "  ".join(f"{k}:{v}" for k, v
+                                 in s["strategies"].items()))
+    return "\n".join(lines)

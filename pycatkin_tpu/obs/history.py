@@ -1,0 +1,190 @@
+"""Rolling bench history and noise-aware regression baselines.
+
+The BENCH_r*.json records the driver checks in at every round are a
+performance time series nobody was reading except by eyeball. This
+module ingests them into a rolling history, computes noise-aware
+baselines (median +/- MAD per metric -- a single flaky round cannot
+drag a mean), and flags the metrics of a candidate record that sit
+beyond the noise band in the BAD direction, with dominant-span and
+cost-ledger attribution when the records carry the forensics to name a
+culprit. ``tools/perfwatch.py`` is the CLI face; ``make perfwatch`` /
+the CI lane run its selftest (an injected 2x regression must be
+flagged, an in-noise wobble must not).
+
+Pure host-side JSON shuffling -- no JAX, importable from CI tooling.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+# Tracked metrics: JSON key -> direction ("higher"/"lower" = which way
+# is good). Keys missing from a record are skipped, so the same table
+# serves full-bench and smoke records.
+TRACKED_METRICS = {
+    "value": "higher",              # pts/s, the headline throughput
+    "mfu": "higher",                # achieved model-flop utilization
+    "prewarm_warm_s": "lower",      # warm-disk restart cost
+    "prewarm_warm_pack_s": "lower",  # warm-from-pack boot cost
+    "max_over_median": "lower",     # trial variance
+}
+
+# A regression must clear BOTH gates: beyond ``mad_k`` median absolute
+# deviations of the history (noise-aware) AND beyond ``rel_floor``
+# relative change (so a dead-quiet history with MAD ~ 0 does not flag
+# every rounding wobble).
+DEFAULT_MAD_K = 4.0
+DEFAULT_REL_FLOOR = 0.10
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _unwrap(record: dict) -> dict:
+    """A BENCH_r*.json as checked in wraps the bench's JSON line under
+    ``{"parsed": {...}}``; raw records pass through unchanged."""
+    if isinstance(record, dict) and isinstance(record.get("parsed"),
+                                               dict):
+        return record["parsed"]
+    return record if isinstance(record, dict) else {}
+
+
+def extract_metrics(record: dict) -> dict:
+    """``{metric: float}`` of every tracked, present, finite metric in
+    one (possibly wrapped) bench record. ``mfu`` is pulled from the
+    cost-ledger totals when the record carries one."""
+    rec = _unwrap(record)
+    out = {}
+    for key in TRACKED_METRICS:
+        v = rec.get(key)
+        if key == "mfu" and v is None:
+            v = ((rec.get("cost_ledger") or {}).get("totals")
+                 or {}).get("mfu")
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            continue
+        out[key] = f
+    return out
+
+
+def load_history(root: str, pattern: str = "BENCH_r*.json") -> list:
+    """``[{"round", "path", "record", "metrics"}]`` for every parseable
+    BENCH round file under ``root``, ascending round order. Unreadable
+    files are skipped -- history ingest must never kill the watcher."""
+    out = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        m = _ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                record = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = _unwrap(record)
+        out.append({"round": int(m.group(1)), "path": path,
+                    "record": rec, "metrics": extract_metrics(rec)})
+    out.sort(key=lambda e: e["round"])
+    return out
+
+
+def baseline(values: list) -> dict | None:
+    """Noise-aware baseline of one metric's history: ``{"median",
+    "mad", "n"}`` (MAD = median absolute deviation -- robust to one
+    flaky round in a way a mean/stddev is not). None for an empty
+    history."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    n = len(vals)
+    med = (vals[n // 2] if n % 2
+           else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    dev = sorted(abs(v - med) for v in vals)
+    mad = (dev[n // 2] if n % 2
+           else 0.5 * (dev[n // 2 - 1] + dev[n // 2]))
+    return {"median": med, "mad": mad, "n": n}
+
+
+def flag_regressions(history: list, candidate: dict,
+                     mad_k: float = DEFAULT_MAD_K,
+                     rel_floor: float = DEFAULT_REL_FLOOR,
+                     min_history: int = 3) -> list:
+    """Findings for every tracked metric of ``candidate`` (a bench
+    record, wrapped or raw) that regressed beyond the noise band of
+    ``history`` (the output of :func:`load_history`, or any list of
+    entries carrying ``"metrics"``).
+
+    A metric is flagged only when (a) the history holds at least
+    ``min_history`` samples of it, and (b) the candidate sits beyond
+    ``max(mad_k * MAD, rel_floor * |median|)`` of the median in the bad
+    direction. Each finding carries the baseline and the attribution of
+    :func:`attribute_regression`.
+    """
+    cand = extract_metrics(candidate)
+    findings = []
+    for metric, value in sorted(cand.items()):
+        series = [e["metrics"][metric] for e in history
+                  if metric in e.get("metrics", {})]
+        base = baseline(series)
+        if base is None or base["n"] < min_history:
+            continue
+        band = max(mad_k * base["mad"],
+                   rel_floor * abs(base["median"]))
+        delta = value - base["median"]
+        bad = (delta < -band
+               if TRACKED_METRICS[metric] == "higher"
+               else delta > band)
+        if not bad:
+            continue
+        ratio = (value / base["median"] if base["median"] else None)
+        findings.append({
+            "metric": metric, "value": value,
+            "median": base["median"], "mad": base["mad"],
+            "n_history": base["n"],
+            "band": band,
+            "ratio": round(ratio, 4) if ratio is not None else None,
+            "direction": TRACKED_METRICS[metric],
+            "attribution": attribute_regression(candidate, history),
+        })
+    return findings
+
+
+def attribute_regression(candidate: dict, history: list) -> dict:
+    """Best-effort blame for a flagged record: the candidate's own
+    dominant-span outlier attribution (``outlier_span`` /
+    ``outlier``), plus the cost-ledger programs whose per-program MFU
+    dropped the most against the newest history record that also
+    carries a ledger. Every probe degrades to absent keys."""
+    cand = _unwrap(candidate)
+    out: dict = {}
+    span = cand.get("outlier") or cand.get("outlier_span")
+    if isinstance(span, dict):
+        out["dominant_span"] = {k: span[k]
+                               for k in ("label", "extra_s")
+                               if k in span}
+    cled = (cand.get("cost_ledger") or {}).get("programs") or {}
+    prior_led = {}
+    for entry in reversed(history):
+        rec = entry.get("record") or {}
+        led = (rec.get("cost_ledger") or {}).get("programs") or {}
+        if led:
+            prior_led = led
+            break
+    drops = []
+    for key, row in cled.items():
+        mfu = row.get("mfu") or row.get("achieved_flops_per_s")
+        prev = prior_led.get(key, {})
+        pmfu = prev.get("mfu") or prev.get("achieved_flops_per_s")
+        if mfu is None or pmfu is None or pmfu <= 0:
+            continue
+        if mfu < pmfu:
+            drops.append({"key": key,
+                          "label": row.get("label") or row.get("kind"),
+                          "ratio": round(mfu / pmfu, 4)})
+    if drops:
+        drops.sort(key=lambda d: d["ratio"])
+        out["cost_ledger_drops"] = drops[:3]
+    return out

@@ -12,7 +12,9 @@ Schema (docs/observability.md): ``schema``, ``git``, ``backend``
 (every SET ``PYCATKIN_*`` knob, verbatim), ``registered_env_keys`` (the
 PCL006 registry, so a reader can tell "unset" from "unknown"),
 ``jax_platforms``, ``abi`` (enabled + bucket fingerprint when a spec is
-given), ``aot_key_version``, ``program_budget``.
+given), ``aot_key_version``, ``program_budget``, ``cost_ledger`` (the
+obs/costs.py snapshot with per-program MFU, None until something
+dispatched).
 """
 
 from __future__ import annotations
@@ -98,6 +100,19 @@ def _aot_key_version():
         return None
 
 
+def _cost_ledger():
+    # Only when programs actually ran: an empty ledger means the run
+    # never dispatched a registered executable, and None reads better
+    # in the manifest than an all-zero snapshot.
+    try:
+        from . import costs
+        if len(costs.default_ledger) == 0:
+            return None
+        return costs.ledger_snapshot()
+    except Exception:
+        return None
+
+
 def _program_budget():
     # batch imports JAX; only consult it when the caller already did.
     if "pycatkin_tpu.parallel.batch" not in sys.modules:
@@ -126,4 +141,5 @@ def run_manifest(mesh=None, spec=None) -> dict:
         "abi": _abi_info(spec),
         "aot_key_version": _aot_key_version(),
         "program_budget": _program_budget(),
+        "cost_ledger": _cost_ledger(),
     }

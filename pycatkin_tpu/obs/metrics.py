@@ -111,6 +111,42 @@ class Histogram(_Instrument):
                 if value <= le:
                     st["buckets"][i] += 1
 
+    def observe_many(self, values, **labels):
+        """Bulk-observe an array/iterable of values as ONE lock
+        acquisition (the per-lane telemetry feed observes 10^4-10^5
+        lane samples per sweep; a Python-loop ``observe`` per lane
+        would dominate the host tail). Uses numpy's searchsorted when
+        available, falling back to a pure-Python count."""
+        self._check_labels(labels)
+        key = _label_key(labels)
+        try:
+            import numpy as np
+            vals = np.asarray(values, dtype=float).ravel()
+            if vals.size == 0:
+                return
+            counts = np.searchsorted(np.sort(vals),
+                                     np.asarray(self.buckets),
+                                     side="right")
+            total, n = float(vals.sum()), int(vals.size)
+            per_bucket = [int(c) for c in counts]
+        except ImportError:       # pure-Python fallback, same result
+            vals = [float(v) for v in values]
+            if not vals:
+                return
+            total, n = sum(vals), len(vals)
+            per_bucket = [sum(1 for v in vals if v <= le)
+                          for le in self.buckets]
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = {"sum": 0.0, "count": 0,
+                      "buckets": [0] * len(self.buckets)}
+                self._values[key] = st
+            st["sum"] += total
+            st["count"] += n
+            for i, c in enumerate(per_bucket):
+                st["buckets"][i] += c
+
     def values(self) -> dict:
         with self._lock:
             return {k: {"sum": st["sum"], "count": st["count"],

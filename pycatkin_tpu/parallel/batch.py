@@ -29,8 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import engine
 from ..frontend import abi as _abi
 from ..frontend.spec import Conditions, ModelSpec
+from ..obs import costs as _costs
 from ..obs import metrics as _metrics
-from ..solvers.newton import SolverOptions
+from ..solvers.newton import STRATEGY_CODES, SolverOptions
 from ..solvers.ode import ODEOptions
 from ..utils.profiling import host_sync, record_event, span
 from ..utils.retry import call_with_backend_retry
@@ -202,8 +203,9 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
     key = compile_pool.program_key(kind, args)
     exe = compile_pool.lookup(spec, key)
     if exe is not None:
+        t0 = _time_mod.perf_counter()
         try:
-            return exe(*args)
+            out = exe(*args)
         except Exception as e:
             compile_pool.unregister(spec, key)
             record_event("degradation", label="aot:fallback",
@@ -212,12 +214,22 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
                 "pycatkin_aot_fallback_total",
                 "registered AOT executables evicted to the jit "
                 "fallback").inc()
+        else:
+            # Dispatch wall into the cost ledger. On the async backend
+            # this is enqueue time only; the hot paths that own the
+            # matching materialization fold its blocked wall onto the
+            # same key (count=0), so MFU denominators stay honest.
+            _costs.note_dispatch(key, _time_mod.perf_counter() - t0)
+            return out
     # Registry miss: the jitted fallback traces + compiles SYNCHRONOUSLY
     # on its first call at this shape, which is exactly the in-band
     # recompile the variance forensics hunt for -- the span carries the
     # wall so a slow trial can be attributed to a named program.
     with span(f"inband:{kind.split(':', 1)[0]}", key=key[:8]):
-        return prog(*args)
+        t0 = _time_mod.perf_counter()
+        out = prog(*args)
+        _costs.note_dispatch(key, _time_mod.perf_counter() - t0)
+        return out
 
 
 def _donate_argnums(argnums):
@@ -739,6 +751,7 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                                   effective_unit_roundoff,
                                   lane_finite_mask,
                                   lyapunov_certified_stable,
+                                  packed_lane_telemetry,
                                   packed_sweep_diagnostics,
                                   stability_tolerance_from_scale)
 
@@ -809,13 +822,20 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                 lane_ok = ok_spec & jnp.isfinite(tofs)
                 n_neg = jnp.sum(lane_ok & (tofs < 0.0))
                 outs += [tofs, act, neg]
+            # Packed per-lane telemetry (iterations/chords/residual
+            # decade/strategy) rides as the second-to-last output, so
+            # the clean tail syncs it in the SAME batched device_get
+            # as the diagnostics bundle -- sync count unchanged.
+            outs.append(packed_lane_telemetry(res.iterations, res.chords,
+                                              res.residual))
             outs.append(packed_sweep_diagnostics(succ0, quar, amb,
                                                  demoted, n_neg))
             return tuple(outs)
 
         kw = {"donate_argnums": _donate_argnums((2,))}
         if out_sharding is not None:
-            n_lane_outs = 2 + (2 if check_stability else 0) \
+            # 3 = res + quar + the [lanes, 4] telemetry pack.
+            n_lane_outs = 3 + (2 if check_stability else 0) \
                 + (3 if has_tof else 0)
             repl = NamedSharding(out_sharding.mesh, P())
             kw["out_shardings"] = (out_sharding,) * n_lane_outs + (repl,)
@@ -885,6 +905,10 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
             lane_ok = ok_spec & jnp.isfinite(tofs)
             n_neg = jnp.sum(lane_ok & (tofs < 0.0))
             outs += [tofs, act, neg]
+        # Same second-to-last telemetry slot as the ABI branch (the
+        # clean tail's single batched sync depends on the ordering).
+        outs.append(packed_lane_telemetry(res.iterations, res.chords,
+                                          res.residual))
         outs.append(packed_sweep_diagnostics(succ0, quar, amb, demoted,
                                              n_neg))
         return tuple(outs)
@@ -894,8 +918,8 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
         # out_shardings is a pytree PREFIX over the output tuple: one
         # sharding per top-level element (the SteadyStateResults
         # subtree takes the lane sharding wholesale; the scalar bundle
-        # is replicated).
-        n_lane_outs = 2 + (2 if check_stability else 0) \
+        # is replicated). 3 = res + quar + the [lanes, 4] telemetry.
+        n_lane_outs = 3 + (2 if check_stability else 0) \
             + (3 if has_tof else 0)
         repl = NamedSharding(out_sharding.mesh, P())
         kw["out_shardings"] = (out_sharding,) * n_lane_outs + (repl,)
@@ -1127,7 +1151,8 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
             opts: SolverOptions, strategy: str, pad_to: int = 64,
             seed: int = 1, use_x0: bool = True,
             neighbor_seed: bool = False, n_failed: int | None = None,
-            mesh: Optional[Mesh] = None):
+            mesh: Optional[Mesh] = None,
+            codes: Optional[np.ndarray] = None, code: int = 0):
     """Host-side second pass over FAILED lanes only: re-solve the failed
     subset with the given strategy/options from the best iterates of the
     first pass. Padded to a multiple of ``pad_to`` so recompiles stay
@@ -1149,6 +1174,9 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     ``n_failed``: the caller's already-materialized failed-lane count
     (skips this function's scalar pre-check round trip -- each
     materialization call costs ~0.1-1 s on the tunneled backend).
+    ``codes``/``code``: optional host int32 [lanes] strategy-code array
+    (telemetry column 3, :data:`solvers.newton.STRATEGY_CODES`) --
+    every lane THIS pass recovers is stamped with ``code`` in place.
     ``mesh``: the sweep's lane mesh -- the failed subset is re-placed
     on it so the prewarmed SHARDED rescue executable is hit, and the
     merged result is re-sharded so downstream tail programs keep their
@@ -1245,6 +1273,8 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     # report their true total cost, not the capped fast-pass numbers.
     iters[idx] += np.asarray(out.iterations)[:len(idx)]  # sync-ok: failure path
     atts[idx] += np.asarray(out.attempts)[:len(idx)]  # sync-ok: failure path
+    if codes is not None:
+        codes[idx[got]] = np.int32(code)
     # Forensic fields follow the iterate actually stored: recovered
     # lanes take the rescue attempt's diagnostics; still-failed lanes
     # keep the ones describing the res.x they still carry.
@@ -1257,6 +1287,14 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
         arr = np.array(cur)
         arr[idx[got]] = np.asarray(new)[:len(idx)][got]  # sync-ok: failure path
         extra[name] = jnp.asarray(arr)
+    # Chord counts accumulate like iterations (total cost, every pass),
+    # not follow-the-iterate like the verdict fields above.
+    cur_ch = getattr(res, "chords", None)
+    new_ch = getattr(out, "chords", None)
+    if cur_ch is not None and new_ch is not None:
+        ch = np.array(cur_ch)  # sync-ok: failure path
+        ch[idx] += np.asarray(new_ch)[:len(idx)]  # sync-ok: failure path
+        extra["chords"] = jnp.asarray(ch)
     merged = res._replace(x=jnp.asarray(x), success=jnp.asarray(succ),
                           residual=jnp.asarray(resid),
                           iterations=jnp.asarray(iters),
@@ -1369,18 +1407,23 @@ def _sweep_steady_state_tail(spec, conds, tof_mask, x0, opts, mesh,
 
 
 def _assemble_clean(res, quar, stable, tofs, act,
-                    check_stability: bool, has_tof: bool, n_neg: int):
+                    check_stability: bool, has_tof: bool, n_neg: int,
+                    lane_tel=None):
     """Sweep result dict from already-computed device arrays -- no
     materialization happens here (the caller already has every count it
     needs). Mirrors _finish_sweep's clean-branch assembly exactly so
-    the fused path's output is field-for-field identical."""
+    the fused path's output is field-for-field identical.
+    ``lane_tel``: the already-materialized [lanes, 4] packed telemetry
+    that rode the bundle sync."""
     out = {"y": res.x, "success": res.success,
            "residual": res.residual, "iterations": res.iterations,
            "attempts": res.attempts, "quarantined": quar}
-    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit", "chords"):
         v = getattr(res, name, None)
         if v is not None:
             out[name] = v
+    if lane_tel is not None:
+        out["lane_telemetry"] = lane_tel
     if check_stability:
         out["stable"] = stable
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
@@ -1431,15 +1474,26 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
 
     def run_fused():
         # Keys are rebuilt per retry (the program donates them); the
-        # ONE materialization (the packed bundle) rides inside the
-        # retried unit so an execution-time transport flake re-runs
-        # the whole (pure) program.
+        # ONE materialization (the telemetry pack + packed bundle, a
+        # single batched device_get) rides inside the retried unit so
+        # an execution-time transport flake re-runs the whole (pure)
+        # program.
         keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
         if sh is not None:
             keys = jax.device_put(keys, sh)
+        fkey = compile_pool.program_key(
+            kind, _prog_args(spec, (conds, keys, x0) + tail))
         out = _registered_call(spec, kind, prog,
                                (conds, keys, x0) + tail)
-        return out[:-1] + (host_sync(out[-1], "fused tail bundle"),)
+        t0 = _time_mod.perf_counter()
+        tel, bundle = host_sync((out[-2], out[-1]),
+                                "fused tail bundle")
+        # The bundle materialization IS this dispatch's blocked wall;
+        # fold it onto the fused program's ledger row (count=0: the
+        # dispatch itself was already counted by _registered_call).
+        _costs.note_dispatch(fkey, _time_mod.perf_counter() - t0,
+                             count=0)
+        return out[:-2] + (tel, bundle)
 
     with span("fused sweep"):
         out = call_with_backend_retry(run_fused,
@@ -1454,6 +1508,8 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     if has_tof:
         tofs, act, neg = out[pos], out[pos + 1], out[pos + 2]
         pos += 3
+    lane_tel = out[pos]
+    pos += 1
     nf, nq, n_amb, n_dem, n_neg = (int(c) for c in out[pos])
 
     # Escalation instrument from the already-materialized bundle
@@ -1469,8 +1525,10 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     if nf == 0 and (not check_stability
                     or (n_amb == 0 and n_dem == 0)):
         # Clean sweep: everything already computed; no further syncs.
+        _note_lane_telemetry(lane_tel, spec)
         return _assemble_clean(res, quar, cert, tofs, act,
-                               check_stability, has_tof, n_neg)
+                               check_stability, has_tof, n_neg,
+                               lane_tel=lane_tel)
 
     if nf == 0 and check_stability and n_amb > 0 and n_dem == n_amb:
         # Tier-2-only escalation: every demoted lane is merely
@@ -1490,9 +1548,12 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
             # masks -- only the n_neg aggregate did, recounted here
             # from the per-lane negatives with every lane now ok).
             n_neg2 = int(np.sum(got[2])) if has_tof else 0
+            # res.x never changed, so the fused telemetry pack is
+            # still the truth (strategy stays 0 -- no rescue ran).
+            _note_lane_telemetry(lane_tel, spec)
             return _assemble_clean(res, quar, jnp.asarray(stable_h),
                                    tofs, act, check_stability, has_tof,
-                                   n_neg2)
+                                   n_neg2, lane_tel=lane_tel)
         # Host eig DEMOTED lanes: they need the unseeded re-solve +
         # re-judge loop -- exact legacy territory (below).
 
@@ -1532,6 +1593,71 @@ def _tail_bundle(success, quarantined, ambiguous, demoted, n_neg):
     from ..solvers.newton import packed_sweep_diagnostics
     return packed_sweep_diagnostics(success, quarantined, ambiguous,
                                     demoted, n_neg)
+
+
+# Device-side lane-telemetry pack for the LEGACY split tail (the fused
+# program computes its own copy in-program); rides the "sweep tail
+# bundle" sync so the legacy clean path's sync count does not grow.
+@jax.jit
+def _lane_telemetry_bundle(iterations, chords, residual):
+    from ..solvers.newton import packed_lane_telemetry
+    return packed_lane_telemetry(iterations, chords, residual)
+
+
+# Histogram buckets for the lane telemetry feed: iteration/chord counts
+# follow a 1..1000 ladder (the solver caps max_steps well below 1000);
+# residual decades span the f64 convergence range.
+_LANE_COUNT_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                       500.0, 1000.0)
+_LANE_DECADE_BUCKETS = (-16.0, -14.0, -12.0, -10.0, -8.0, -6.0, -4.0,
+                        -2.0, 0.0)
+
+
+def _note_lane_telemetry(tel, spec):
+    """Feed one sweep's materialized [lanes, 4] telemetry pack into the
+    per-lane histograms, labeled by the ABI bucket the sweep ran in
+    (``unbucketed`` for legacy per-mechanism programs). Bulk
+    ``observe_many`` -- one lock acquisition per column, not per lane."""
+    if tel is None:
+        return
+    bucket = str(getattr(spec, "abi_fingerprint", None) or "unbucketed")
+    tel = np.asarray(tel)
+    _metrics.histogram(
+        "pycatkin_lane_iterations",
+        "per-lane solver iteration counts",
+        buckets=_LANE_COUNT_BUCKETS).observe_many(
+            tel[:, 0], abi_bucket=bucket)
+    _metrics.histogram(
+        "pycatkin_lane_chords",
+        "per-lane accepted chord re-solves",
+        buckets=_LANE_COUNT_BUCKETS).observe_many(
+            tel[:, 1], abi_bucket=bucket)
+    _metrics.histogram(
+        "pycatkin_lane_residual_decade",
+        "per-lane final-residual decade (floor log10)",
+        buckets=_LANE_DECADE_BUCKETS).observe_many(
+            tel[:, 2], abi_bucket=bucket)
+
+
+def _host_lane_telemetry(res, quar, strategy_codes):
+    """Host-side twin of :func:`solvers.newton.packed_lane_telemetry`
+    for the FAILURE path, where the merged result already lives in host
+    memory and the strategy column carries the rescue ladder's verdict
+    per lane: same columns, same decade clipping as the device pack."""
+    it = np.asarray(res.iterations).astype(np.int32)  # sync-ok: failure path
+    ch = getattr(res, "chords", None)
+    ch = (np.asarray(ch).astype(np.int32) if ch is not None  # sync-ok: failure path
+          else np.zeros_like(it))
+    r = np.asarray(res.residual, dtype=np.float64)  # sync-ok: failure path
+    with np.errstate(divide="ignore", invalid="ignore"):
+        dec = np.floor(np.log10(np.where(r > 0, r, 1.0)))
+    dec = np.where(r > 0, dec, -99.0)
+    dec = np.where(np.isfinite(r), dec, 99.0)
+    dec = np.clip(dec, -99, 99).astype(np.int32)
+    strat = np.where(np.asarray(quar).astype(bool),  # sync-ok: failure path
+                     np.int32(STRATEGY_CODES["quarantine"]),
+                     np.asarray(strategy_codes, dtype=np.int32))
+    return np.stack([it, ch, dec, strat.astype(np.int32)], axis=-1)
 
 
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
@@ -1595,21 +1721,27 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                 spec, "tof", _tof_program(_prog_spec(spec)),
                 (conds, res.x, mask_arr, ok_spec))
         bundle = _tail_bundle(succ0, quar, amb, demoted, n_neg_dev)
-        return (cert, amb, n_amb_dev, tofs, act,
-                host_sync(bundle, "sweep tail bundle"))
+        tel_dev = _lane_telemetry_bundle(res.iterations,
+                                         getattr(res, "chords", None),
+                                         res.residual)
+        tel, counts = host_sync((tel_dev, bundle), "sweep tail bundle")
+        return (cert, amb, n_amb_dev, tofs, act, tel, counts)
 
     with span("sweep tail"):
-        cert, amb, n_amb_dev, tofs, act, counts = call_with_backend_retry(
-            run_tail, label="sweep tail")
+        (cert, amb, n_amb_dev, tofs, act, lane_tel,
+         counts) = call_with_backend_retry(run_tail, label="sweep tail")
     nf, nq, n_amb, n_dem, n_neg = (int(c) for c in counts)
 
     if nf == 0 and (not check_stability
                     or (n_amb == 0 and n_dem == 0)):
         # Clean sweep: everything already computed; no further syncs.
+        _note_lane_telemetry(lane_tel, spec)
         out = {"y": res.x, "success": res.success,
                "residual": res.residual, "iterations": res.iterations,
-               "attempts": res.attempts, "quarantined": quar}
-        for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+               "attempts": res.attempts, "quarantined": quar,
+               "lane_telemetry": lane_tel}
+        for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit",
+                     "chords"):
             v = getattr(res, name, None)
             if v is not None:
                 out[name] = v
@@ -1639,19 +1771,26 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     # compiled program (the warm wall is latency-bound at this bucket
     # width, ~2 s either way; the headroom pays on harder grids).
     nf0 = nf
+    # Per-lane rescue-strategy codes (telemetry column 3): 0 until a
+    # ladder rung actually recovers the lane; quarantine stamps last.
+    strat_h = np.zeros(
+        jax.tree_util.tree_leaves(conds)[0].shape[0], dtype=np.int32)
     if nf > 0:
         # Seeded near-Newton polish first: the cheap pass that
         # converges the whole tail in the common case (see
         # _polish_opts). The full ladder and the LM strategy remain
         # behind it for whatever survives.
         res, nf = _rescue(spec, conds, res, _polish_opts(opts), "ptc",
-                          neighbor_seed=True, n_failed=nf, mesh=mesh)
+                          neighbor_seed=True, n_failed=nf, mesh=mesh,
+                          codes=strat_h, code=STRATEGY_CODES["polish"])
     if nf > 0:
         res, nf = _rescue(spec, conds, res, opts, "ptc",
-                          neighbor_seed=True, n_failed=nf, mesh=mesh)
+                          neighbor_seed=True, n_failed=nf, mesh=mesh,
+                          codes=strat_h, code=STRATEGY_CODES["ptc"])
     if nf > 0:
         res, nf = _rescue(spec, conds, res, opts, "lm", n_failed=nf,
-                          mesh=mesh)
+                          mesh=mesh, codes=strat_h,
+                          code=STRATEGY_CODES["lm"])
     if nf0 > 0:
         # Re-check after the ladder: a poisoned RESCUE dispatch can
         # write fresh non-finite "successes" (fault sites rescue[*]);
@@ -1689,7 +1828,9 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
             res = res._replace(
                 success=jnp.asarray(res.success) & stable)
             res, _ = _rescue(spec, conds, res, opts, "ptc",
-                             seed=17 + round_i, use_x0=False, mesh=mesh)
+                             seed=17 + round_i, use_x0=False, mesh=mesh,
+                             codes=strat_h,
+                             code=STRATEGY_CODES["demote"])
             stable = stability_mask(spec, conds, res.x,
                                     pos_tol=pos_jac_tol,
                                     ok=res.success, backend=backend,
@@ -1699,10 +1840,17 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
            "quarantined": quar}
     # Per-lane forensic diagnostics (verdict breakdown + exit
     # pseudo-step) ride along whenever the solver produced them.
-    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit"):
+    for name in ("rate_ok", "pos_ok", "sums_ok", "dt_exit", "chords"):
         v = getattr(res, name, None)
         if v is not None:
             out[name] = v
+    # The speculative device telemetry pack is stale once the ladder
+    # rewrote lanes; rebuild it host-side from the merged result (the
+    # failure path pays per-stage syncs anyway) with the ladder's
+    # strategy verdicts in column 3.
+    tel = _host_lane_telemetry(res, quar, strat_h)
+    out["lane_telemetry"] = tel
+    _note_lane_telemetry(tel, spec)
     if check_stability:
         out["stable"] = stable
         out["success"] = jnp.logical_and(jnp.asarray(res.success),
@@ -2024,6 +2172,10 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         """Registry/cache lookup for one program; returns True when an
         executable is already available (registered now or before)."""
         key = compile_pool.program_key(kind, args)
+        # Name the ledger row whatever happens next: the cost numbers
+        # arrive from cache.save/load, but kind/label only prewarm
+        # knows (program keys are hashes).
+        _costs.record(key, kind=kind, label=label)
         if compile_pool.lookup(pspec, key) is not None:
             return key, True
         try:
@@ -2051,6 +2203,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         cache.save(job["key"], exe,
                    sharding=compile_pool.args_sharding_fingerprint(
                        job["args"]))
+        # Direct harvest too: cache.save only harvests when the disk
+        # layer is enabled, and every prewarmed program must own a
+        # ledger row regardless (bench.py --smoke costs_ok gate).
+        _costs.record(job["key"], kind=job["kind"], label=job["label"],
+                      cost=_costs.harvest_cost(exe), source="compiled")
         compile_pool.register(pspec, job["key"], exe)
         return exe
 
@@ -2344,6 +2501,7 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
             continue                       # cannot recompile here
         if exe is not None:
             compile_pool.register(pspec, key, exe)
+            _costs.record(key, kind=kind)
             n_loaded += 1
     return n_loaded
 

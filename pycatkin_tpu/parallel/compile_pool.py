@@ -55,6 +55,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..obs import costs as _costs
 from ..obs import metrics as _metrics
 
 _DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
@@ -123,8 +124,11 @@ def spec_fingerprint(spec) -> str:
 # plain (silent) miss. v2: program keys carry the per-argument sharding
 # fingerprint, so executables compiled for a sharded mesh layout can be
 # cached and looked up without ever colliding with the single-device
-# entries of the same shapes.
-_KEY_VERSION = "aot-key-v2"
+# entries of the same shapes. v3: the solver result grew a per-lane
+# chord-count field and the fused sweep program a packed lane-telemetry
+# output -- executables serialized before that return the OLD output
+# structure, which would unpack wrong with success=True.
+_KEY_VERSION = "aot-key-v3"
 
 
 def _leaf_sharding_tag(leaf) -> str:
@@ -321,6 +325,10 @@ class AOTCache:
         except Exception:               # corrupt payload: plain miss
             self._tick("misses")
             return None
+        # Replay the compile-time cost analyses recorded at save time:
+        # a deserialized executable cannot recompute them on every
+        # backend, so the entry is the only place they survive.
+        _costs.record(key, cost=entry.get("cost"), source="cache")
         self._tick("hits")
         return exe
 
@@ -355,6 +363,14 @@ class AOTCache:
             # mechanism in the bucket, and pack consumers audit that
             # claim from the manifest without parsing fingerprints.
             entry.update(abi_entry_fields(self.fingerprint))
+            # Compile-time device-cost truth rides in the entry (and on
+            # into pack manifests via _entry_meta): load() replays it
+            # into the cost ledger, so cache-warmed processes still
+            # know what their programs cost.
+            cost = _costs.harvest_cost(compiled)
+            if cost:
+                entry["cost"] = cost
+            _costs.record(key, cost=cost, source="compiled")
             blob = pickle.dumps(entry)
             os.makedirs(self.root, exist_ok=True)
             tmp = self._path(key) + f".tmp.{os.getpid()}"
@@ -489,7 +505,7 @@ def _entry_meta(path: str) -> dict:
             "sharding": entry.get("sharding", ""),
             "devices": entry.get("devices"),
             "size": os.path.getsize(path)}
-    for k in ("abi_version", "abi_bucket"):
+    for k in ("abi_version", "abi_bucket", "cost"):
         if k in entry:
             meta[k] = entry[k]
     return meta
@@ -617,6 +633,11 @@ def import_cache_pack(pack_path: str, cache_root: str | None = None,
             with open(tmp, "wb") as out:
                 out.write(blob)
             os.replace(tmp, os.path.join(root, name))
+            # Pack-shipped cost rows land in the ledger immediately --
+            # a worker booted from a pack may never call load() before
+            # its first manifest/bench snapshot.
+            if isinstance(meta.get("cost"), dict):
+                _costs.record(key, cost=meta["cost"], source="pack")
             imported += 1
             total += len(blob)
     _metrics.counter("pycatkin_aot_pack_imports_total",

@@ -52,7 +52,10 @@ class SteadyStateResults(NamedTuple):
     means the march was still fighting rejections, a huge one means it
     reached the Newton regime and stalled elsewhere. They default to
     None so pre-existing 5-field constructions keep working; the solver
-    always fills them.
+    always fills them. ``chords`` counts the accepted chord re-solves
+    (frozen-Jacobian steps) the solve spent -- 0 whenever
+    ``chord_steps`` is off -- and feeds the per-lane solver telemetry
+    (docs/perf_cost_ledger.md) alongside ``iterations``.
     """
     x: jnp.ndarray
     success: jnp.ndarray
@@ -63,6 +66,7 @@ class SteadyStateResults(NamedTuple):
     pos_ok: jnp.ndarray | None = None
     sums_ok: jnp.ndarray | None = None
     dt_exit: jnp.ndarray | None = None
+    chords: jnp.ndarray | None = None
 
 
 class SolverOptions(NamedTuple):
@@ -192,21 +196,25 @@ def conservation_constraints(groups_dyn):
 
 def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     """One PTC run from x0; returns (x, normalized_residual, steps,
-    dt_at_exit).
+    dt_at_exit, chords_accepted).
 
     ``fscale_fn(x) -> (F, gross)`` returns the residual and the gross
     flux scale in one evaluation; both are carried between iterations so
-    each step costs one Jacobian and one fresh evaluation."""
+    each step costs one Jacobian and one fresh evaluation.
+    ``chords_accepted`` counts the chord re-solves whose accept test
+    passed -- pure telemetry riding the carry (the counter never feeds
+    back into the iterate, so the x/residual path is bitwise identical
+    to the pre-counter solver)."""
     n = x0.shape[0]
     eye = jnp.eye(n, dtype=x0.dtype)
     R, M = conservation_constraints(groups_dyn)
 
     def cond(state):
-        x, F, dt, fnorm, k = state
+        x, F, dt, fnorm, k, nch = state
         return (k < opts.max_steps) & (fnorm > 1.0)
 
     def body(state):
-        x, F, dt, fnorm, k = state
+        x, F, dt, fnorm, k, nch = state
         J = jac_fn(x)
         A = jnp.where(M[:, None] > 0, R, eye / dt - J)
         solve_fn = _direction_factor(A, opts)
@@ -236,6 +244,7 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         # verdict and the returned residual all use the same fresh
         # yardstick and a borderline lane cannot exit "converged" only
         # to fail the verdict and burn a full extra attempt.
+        nch_step = jnp.zeros((), dtype=jnp.int32)
         for _ in range(opts.chord_steps):
             dxc = solve_fn(F_new * (1.0 - M))
             x_c = _normalize(jnp.maximum(x_new + dxc, 0.0), groups_dyn,
@@ -244,6 +253,7 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
             f_c = _rnorm(F_c, gross_new, opts)
             take = (jnp.isfinite(f_c) & jnp.all(jnp.isfinite(x_c))
                     & (f_c < fnorm_new))
+            nch_step = nch_step + take.astype(jnp.int32)
             x_new = jnp.where(take, x_c, x_new)
             F_new = jnp.where(take, F_c, F_new)
             gross_new = jnp.where(take, gross_c, gross_new)
@@ -267,16 +277,21 @@ def _ptc_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
         x_next = jnp.where(accept, x_new, x)
         F_next = jnp.where(accept, F_new, F)
         fnorm_next = jnp.where(accept, fnorm_new, fnorm)
-        return (x_next, F_next, dt_new, fnorm_next, k + 1)
+        # Chords are counted when their accept test passed, whether or
+        # not the enclosing step is kept -- the device work was spent
+        # either way, and telemetry measures spend.
+        return (x_next, F_next, dt_new, fnorm_next, k + 1,
+                nch + nch_step)
 
     F0, gross0 = fscale_fn(x0)
     f0 = _rnorm(F0, gross0, opts)
-    x, F, dt, fnorm, k = jax.lax.while_loop(
-        cond, body, (x0, F0, jnp.asarray(opts.dt0, x0.dtype), f0, 0))
+    x, F, dt, fnorm, k, nch = jax.lax.while_loop(
+        cond, body, (x0, F0, jnp.asarray(opts.dt0, x0.dtype), f0, 0,
+                     jnp.zeros((), dtype=jnp.int32)))
     # With chord steps the carried fnorm is already measured against the
     # accepted iterate's own gross scale (see the body), so no post-loop
     # re-measure is needed and loop exit == verdict yardstick.
-    return x, fnorm, k, dt
+    return x, fnorm, k, dt, nch
 
 
 def _verdict_tests(x, fnorm, groups_dyn, opts: SolverOptions):
@@ -329,6 +344,59 @@ def packed_sweep_diagnostics(success, quarantined, ambiguous=None,
     ])
 
 
+# Rescue-strategy codes for the per-lane telemetry's ``strategy``
+# column: 0 = solved by the fast pass (no rescue), then one code per
+# rung of the rescue ladder in parallel/batch.py, in ladder order, plus
+# the two terminal demotions. The registry is shared by the fused sweep
+# tail (which stamps 0 on device), the host-side rescue merge (which
+# overwrites the code of each rescued lane) and the obsview/heatmap
+# renderers -- one table, no drift.
+STRATEGY_CODES = {
+    "clean": 0,
+    "polish": 1,
+    "ptc": 2,
+    "lm": 3,
+    "unseeded": 4,
+    "demote": 5,
+    "quarantine": 6,
+}
+STRATEGY_NAMES = {v: k for k, v in STRATEGY_CODES.items()}
+
+# Column order of the packed per-lane telemetry array.
+LANE_TELEMETRY_FIELDS = ("iterations", "chords", "residual_decade",
+                         "strategy")
+
+
+def residual_decade(residual):
+    """Final-residual decade per lane: ``floor(log10(residual))``
+    clipped to [-99, 99] as int32, with 99 for a non-finite residual
+    and -99 for an (unreachable in practice) exact zero. One decade is
+    the resolution at which 'how converged is this lane' reads off a
+    heatmap; the exact float residual stays available in
+    ``SteadyStateResults.residual``."""
+    r = jnp.asarray(residual)
+    pos = jnp.where(r > 0, r, 1.0)
+    dec = jnp.floor(jnp.log10(pos))
+    dec = jnp.where(r > 0, dec, -99.0)
+    dec = jnp.where(jnp.isfinite(r), dec, 99.0)
+    return jnp.clip(dec, -99, 99).astype(jnp.int32)
+
+
+def packed_lane_telemetry(iterations, chords, residual, strategy=0):
+    """Per-lane solver telemetry as ONE ``[n, 4]`` int32 array
+    (columns: :data:`LANE_TELEMETRY_FIELDS`). Computed inside the fused
+    sweep program so it rides the existing single-sync bundle -- the
+    clean path's sync count does not grow by adding lane-resolution
+    telemetry (docs/perf_cost_ledger.md)."""
+    it = jnp.asarray(iterations)
+    n = it.shape[0]
+    ch = (jnp.zeros(n, dtype=jnp.int32) if chords is None
+          else jnp.asarray(chords))
+    strat = jnp.broadcast_to(jnp.asarray(strategy, dtype=jnp.int32), (n,))
+    return jnp.stack([it.astype(jnp.int32), ch.astype(jnp.int32),
+                      residual_decade(residual), strat], axis=-1)
+
+
 def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
     """Convergence tests (reference solver.py:69-120 minus the host-only
     eigenvalue check): normalized residual small, coverages non-negative,
@@ -360,8 +428,9 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     ||F/scale||^2 directly, which escapes regions where the pseudo-time
     march cycles. Same projection (clamp + group renormalization) keeps
     iterates physical. Returns (x, normalized_residual, steps,
-    lam_at_exit) -- lam plays the dt_exit diagnostic role (damping at
-    exit), so both strategies share one result layout."""
+    lam_at_exit, chords_accepted) -- lam plays the dt_exit diagnostic
+    role (damping at exit) and chords is always 0 (LM has no chord
+    phase), so both strategies share one result layout."""
     n = x0.shape[0]
     eye = jnp.eye(n, dtype=x0.dtype)
     R, M = conservation_constraints(groups_dyn)
@@ -427,7 +496,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     # whenever GN steps actually fail.
     x, F, gross, fnorm, lam, k = jax.lax.while_loop(
         cond, body, (x0, F0, gross0, f0, jnp.asarray(1e-10, x0.dtype), 0))
-    return x, fnorm, k, lam
+    return x, fnorm, k, lam, jnp.zeros((), dtype=jnp.int32)
 
 
 def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
@@ -448,11 +517,12 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     re-run failed lanes with 'lm' in a second pass (the reference's own
     sequential strategy fallback).
     Returns (x, success, normalized_residual, iterations, attempts,
-    rate_ok, pos_ok, sums_ok, dt_exit) -- the trailing four are the
-    per-lane forensic diagnostics of :class:`SteadyStateResults`:
+    rate_ok, pos_ok, sums_ok, dt_exit, chords) -- the trailing five are
+    the per-lane forensic diagnostics of :class:`SteadyStateResults`:
     the verdict broken into its three tests at the returned iterate,
-    plus the pseudo-step (PTC) or damping (LM) the final attempt
-    exited with.
+    the pseudo-step (PTC) or damping (LM) the final attempt exited
+    with, plus the accepted chord re-solves spent (always 0 for LM or
+    ``chord_steps=0``).
     """
     attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
     # The consolidated rescue program passes pacing knobs (dt0,
@@ -471,8 +541,8 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
         # lexicographic scoreboard degenerates to best-of {x0, x1}.
         F0, gross0 = fscale_fn(x0)
         f0 = _rnorm(F0, gross0, opts)
-        x1, f1, k, dt_exit = attempt_fn(fscale_fn, jac_fn, x0,
-                                        groups_dyn, opts)
+        x1, f1, k, dt_exit, chords = attempt_fn(fscale_fn, jac_fn, x0,
+                                                groups_dyn, opts)
         ok = _verdict(x1, f1, groups_dyn, opts)
         better = _score(x1, f1, groups_dyn, opts) > _score(x0, f0,
                                                           groups_dyn,
@@ -482,18 +552,18 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
         rate_ok, pos_ok, sums_ok = _verdict_tests(x_out, f_out,
                                                   groups_dyn, opts)
         return (x_out, ok, f_out, k, jnp.asarray(1),
-                rate_ok, pos_ok, sums_ok, dt_exit)
+                rate_ok, pos_ok, sums_ok, dt_exit, chords)
     if key is None:
         key = jax.random.PRNGKey(0)
 
     def attempt_cond(state):
         (x, best_x, best_f, best_s, success, iters, attempt, dt_exit,
-         key) = state
+         chords, key) = state
         return (attempt < opts.max_attempts) & (~success)
 
     def attempt_body(state):
         (x, best_x, best_f, best_s, success, iters, attempt, dt_exit,
-         key) = state
+         chords, key) = state
         # Attempt 0 trusts the caller's guess verbatim: even a 1e-9
         # renormalization perturbs residuals by k_max * 1e-9, and
         # restarts risk hopping to a different steady-state branch.
@@ -505,8 +575,9 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                           groups_dyn, opts.floor)
         x_start = jnp.where(attempt == 0, x,
                             jnp.where(attempt == 1, x_norm, rand))
-        x_new, fnorm, k, dt_new = attempt_fn(fscale_fn, jac_fn, x_start,
-                                             groups_dyn, opts)
+        x_new, fnorm, k, dt_new, nch = attempt_fn(fscale_fn, jac_fn,
+                                                  x_start, groups_dyn,
+                                                  opts)
         ok = _verdict(x_new, fnorm, groups_dyn, opts)
         # Lexicographic scoreboard across attempts (reference
         # compare_scores): tests passed first, residual second.
@@ -516,22 +587,23 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
         best_f = jnp.where(better, fnorm, best_f)
         best_s = jnp.where(better, s_new, best_s)
         return (x_new, best_x, best_f, best_s, ok, iters + k,
-                attempt + 1, dt_new, key)
+                attempt + 1, dt_new, chords + nch, key)
 
     F0, gross0 = fscale_fn(x0)
     f0 = _rnorm(F0, gross0, opts)
     s0 = _score(x0, f0, groups_dyn, opts)
     init = (x0, x0, f0, s0, jnp.asarray(False), 0, 0,
-            jnp.asarray(opts.dt0, x0.dtype), key)
+            jnp.asarray(opts.dt0, x0.dtype),
+            jnp.zeros((), dtype=jnp.int32), key)
     (x, best_x, best_f, best_s, success, iters, attempts, dt_exit,
-     _) = jax.lax.while_loop(attempt_cond, attempt_body, init)
+     chords, _) = jax.lax.while_loop(attempt_cond, attempt_body, init)
     x_out = jnp.where(success, x, best_x)
     Fx, grossx = fscale_fn(x)
     f_out = jnp.where(success, _rnorm(Fx, grossx, opts), best_f)
     rate_ok, pos_ok, sums_ok = _verdict_tests(x_out, f_out, groups_dyn,
                                               opts)
     return (x_out, success, f_out, iters, attempts,
-            rate_ok, pos_ok, sums_ok, dt_exit)
+            rate_ok, pos_ok, sums_ok, dt_exit, chords)
 
 
 def deflation_basis(groups_dyn) -> "np.ndarray":

@@ -118,10 +118,22 @@ def host_sync(value, label: str = ""):
     _obs.note_sync(label)
     _metrics.counter("pycatkin_host_syncs_total",
                      "counted blocking device->host syncs").inc()
-    if isinstance(value, (tuple, list, dict)):
-        import jax
-        return jax.tree_util.tree_map(np.asarray, jax.device_get(value))
-    return np.asarray(value)
+    # The materialization below is the actual blocking window: its
+    # duration (not just its count) is what the tunnel bills, so it is
+    # histogrammed per label -- sync COST is budgetable alongside sync
+    # count (docs/observability.md).
+    t0 = time.perf_counter()
+    try:
+        if isinstance(value, (tuple, list, dict)):
+            import jax
+            return jax.tree_util.tree_map(np.asarray,
+                                          jax.device_get(value))
+        return np.asarray(value)
+    finally:
+        _metrics.histogram(
+            "pycatkin_host_sync_seconds",
+            "blocked wall of each counted device->host sync",
+        ).observe(time.perf_counter() - t0, label=label)
 
 
 def sync_count() -> int:

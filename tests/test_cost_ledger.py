@@ -1,0 +1,251 @@
+"""Device cost ledger (obs/costs.py) unit + integration contracts.
+
+The ledger is the compile-time device-cost truth the perf tooling joins
+against: every prewarmed program must own a row with XLA's harvested
+FLOP/byte analyses, the row must survive the AOT pack export -> import
+round trip (a worker booted from a pack never recompiles, so the
+analyses can only ride in the entries), and the dispatch-wall join must
+derive achieved FLOP/s -- while MFU stays ABSENT on CPU, where no
+honest ceiling exists. The unit half pins the defensive harvesting,
+the merge semantics (compile-time harvest wins over a cache replay of
+itself) and the ``count=0`` fold that lets the fused sweep attribute
+its bundle materialization without double-counting the dispatch.
+"""
+
+import math
+import types
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.obs import costs
+from pycatkin_tpu.parallel import compile_pool
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         clear_program_caches,
+                                         prewarm_sweep_programs,
+                                         sweep_steady_state)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    clear_program_caches()
+    costs.reset()
+    yield
+    clear_program_caches()
+    costs.reset()
+
+
+# -- unit: peaks, flop model, harvesting, ledger semantics ------------
+
+def test_device_peak_known_kinds_and_honest_absence():
+    for kind in ("TPU v5 lite", "TPU v5e", "tpu v5p"):
+        peak = costs.device_peak(kind)
+        assert peak is not None, kind
+        assert peak["flops_per_s"] > 0 and peak["bytes_per_s"] > 0
+    # Returned dict is a copy: mutating it must not poison the table.
+    peak = costs.device_peak("TPU v5e")
+    peak["flops_per_s"] = -1.0
+    assert costs.device_peak("TPU v5e")["flops_per_s"] > 0
+    # No fabricated ceiling for unknown kinds -- CPU included.
+    assert costs.device_peak("cpu") is None
+    assert costs.device_peak("") is None
+    assert costs.device_peak(None) is None
+
+
+def test_flops_per_iteration_model_shape():
+    base = costs.flops_per_iteration(24, 32, 20, 1)
+    assert base > 0 and math.isfinite(base)
+    # Chord re-solves add work; more dynamic species add work.
+    assert costs.flops_per_iteration(24, 32, 20, 1, chords=4) > base
+    assert costs.flops_per_iteration(24, 32, 40, 1) > base
+    # Past the unrolled-solve crossover the model switches to the
+    # LU 2/3 n^3 coefficient but must stay monotone in n_dyn.
+    assert (costs.flops_per_iteration(700, 500, 190, 1)
+            > costs.flops_per_iteration(700, 500, 48, 1))
+
+
+def test_harvest_cost_defensive_probes():
+    class _Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend refuses")
+    assert costs.harvest_cost(_Broken()) is None
+
+    class _ListCA:
+        # Older jax returns a list-of-dicts; memory_analysis may raise.
+        def cost_analysis(self):
+            return [{"flops": 12.0, "bytes accessed": 34.0}]
+
+        def memory_analysis(self):
+            raise RuntimeError("absent on this backend")
+    assert costs.harvest_cost(_ListCA()) == {"flops": 12.0,
+                                             "bytes_accessed": 34.0}
+
+    class _Sentinels:
+        # Negative / non-finite values are backend sentinels, not data.
+        def cost_analysis(self):
+            return {"flops": -1.0, "bytes accessed": float("nan")}
+    assert costs.harvest_cost(_Sentinels()) is None
+
+    class _MemOnly:
+        def cost_analysis(self):
+            raise RuntimeError
+        def memory_analysis(self):
+            return types.SimpleNamespace(temp_size_in_bytes=10,
+                                         output_size_in_bytes=20)
+    assert costs.harvest_cost(_MemOnly()) == {"temp_bytes": 10.0,
+                                              "output_bytes": 20.0}
+
+
+def test_record_merge_first_write_wins():
+    led = costs.CostLedger()
+    led.record("k", kind="fused", label="fused sweep",
+               cost={"flops": 5.0}, source="compiled")
+    # A later cache replay of the same program must not overwrite the
+    # compile-time harvest (or the identity fields).
+    led.record("k", kind="other", label="other",
+               cost={"flops": 9.0, "bytes_accessed": 3.0},
+               source="cache")
+    row = led.row("k")
+    assert row["kind"] == "fused" and row["label"] == "fused sweep"
+    assert row["flops"] == 5.0
+    assert row["bytes_accessed"] == 3.0      # gap-filling still merges
+    assert row["source"] == "compiled"
+    assert led.keys() == ["k"] and len(led) == 1
+
+
+def test_note_dispatch_count_zero_folds_wall_without_dispatch():
+    led = costs.CostLedger()
+    led.note_dispatch("k", 0.5)
+    # The fused path's bundle materialization: extra blocked wall on a
+    # dispatch _registered_call already counted.
+    led.note_dispatch("k", 0.25, count=0)
+    row = led.row("k")
+    assert row["dispatches"] == 1
+    assert row["blocked_wall_s"] == pytest.approx(0.75)
+    # Unknown keys still get a (cost-less) row -- the count survives.
+    led.note_dispatch("ghost", 0.1)
+    assert led.row("ghost")["dispatches"] == 1
+
+
+def test_snapshot_derives_mfu_only_with_a_known_peak():
+    led = costs.CostLedger()
+    led.record("k", cost={"flops": 1.519e11, "bytes_accessed": 3.228e11})
+    led.note_dispatch("k", 1.0)
+
+    snap = led.snapshot("TPU v5e")
+    row = snap["programs"]["k"]
+    assert row["achieved_flops_per_s"] == pytest.approx(1.519e11)
+    assert row["mfu"] == pytest.approx(1.0)
+    assert row["hbm_util"] == pytest.approx(1.0)
+    assert snap["totals"]["mfu"] == pytest.approx(1.0)
+    assert snap["peak"]["flops_per_s"] == pytest.approx(1.519e11)
+
+    # CPU: achieved rates still derived, MFU absent -- never fabricated.
+    snap = led.snapshot("cpu")
+    row = snap["programs"]["k"]
+    assert row["achieved_flops_per_s"] == pytest.approx(1.519e11)
+    assert "mfu" not in row and "hbm_util" not in row
+    assert snap["peak"] is None and "mfu" not in snap["totals"]
+
+    # A row with cost but no dispatch derives nothing.
+    led.record("idle", cost={"flops": 1.0})
+    assert "achieved_flops_per_s" not in led.snapshot("cpu")["programs"]["idle"]
+
+
+def test_module_level_ledger_snapshot_probes_live_device():
+    costs.record("k", kind="fused", cost={"flops": 4.0})
+    costs.note_dispatch("k", 0.5)
+    # jax is imported (CPU backend) -> probed kind has no peak.
+    snap = costs.ledger_snapshot()
+    assert snap["peak"] is None
+    assert snap["programs"]["k"]["achieved_flops_per_s"] == pytest.approx(8.0)
+    costs.reset()
+    assert len(costs.default_ledger) == 0
+
+
+# -- integration: prewarm -> ledger rows -> dispatch join -------------
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 24
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(420.0, 780.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def test_every_prewarmed_program_owns_a_cost_row(tmp_path, problem):
+    spec, conds, mask = problem
+    cache = compile_pool.AOTCache(
+        root=str(tmp_path),
+        fingerprint=compile_pool.spec_fingerprint(spec))
+    stats = prewarm_sweep_programs(spec, conds, tof_mask=mask,
+                                   buckets=(), check_stability=False,
+                                   cache=cache)
+    keys = [key for (_spec, key) in compile_pool._REGISTRY]
+    assert len(keys) == int(stats) >= 1
+    for key in keys:
+        row = costs.default_ledger.row(key)
+        assert row is not None, f"prewarmed program {key} has no row"
+        # The CPU backend exposes both analyses; nonneg by harvest rule.
+        assert row.get("flops", -1.0) >= 0.0, key
+        assert row.get("bytes_accessed", -1.0) >= 0.0, key
+        assert "kind" in row, key
+
+    # The dispatch-wall join: one sweep through the registered
+    # executables must light up achieved FLOP/s on the hot programs.
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    assert bool(np.all(np.asarray(out["success"])))
+    snap = costs.default_ledger.snapshot("cpu")
+    hot = [r for r in snap["programs"].values()
+           if r.get("dispatches", 0) > 0 and r.get("blocked_wall_s", 0) > 0]
+    assert hot, "no dispatch ever reached the ledger"
+    assert any("achieved_flops_per_s" in r for r in hot)
+    assert all("mfu" not in r for r in snap["programs"].values())
+    assert snap["totals"]["dispatches"] >= 1
+
+
+def test_cost_rows_survive_pack_round_trip_and_cache_reload(tmp_path,
+                                                            problem):
+    spec, conds, mask = problem
+    fp = compile_pool.spec_fingerprint(spec)
+    root_a, root_b = tmp_path / "a", tmp_path / "b"
+    pack = str(tmp_path / "cache.aotpack.tgz")
+    prewarm_sweep_programs(
+        spec, conds, tof_mask=mask, buckets=(), check_stability=False,
+        cache=compile_pool.AOTCache(root=str(root_a), fingerprint=fp))
+    costed = {k: costs.default_ledger.row(k)
+              for k in costs.default_ledger.keys()}
+    costed = {k: r for k, r in costed.items() if "flops" in r}
+    assert costed, "prewarm harvested no cost rows"
+
+    exported = compile_pool.export_cache_pack(pack, cache_root=str(root_a))
+    assert exported["entries"] >= len(costed)
+
+    # A "worker booted from a pack": empty ledger, import only.
+    costs.reset()
+    assert len(costs.default_ledger) == 0
+    imported = compile_pool.import_cache_pack(pack, cache_root=str(root_b))
+    assert imported["imported"] == exported["entries"]
+    for key, row in costed.items():
+        got = costs.default_ledger.row(key)
+        assert got is not None, f"pack import dropped cost row {key}"
+        assert got["source"] == "pack"
+        assert got["flops"] == row["flops"]
+        assert got.get("bytes_accessed") == row.get("bytes_accessed")
+
+    # A cache-warmed restart replays entry costs at load time.
+    clear_program_caches()
+    costs.reset()
+    stats = prewarm_sweep_programs(
+        spec, conds, tof_mask=mask, buckets=(), check_stability=False,
+        cache=compile_pool.AOTCache(root=str(root_b), fingerprint=fp))
+    assert stats.compiled == 0 and stats.loaded == int(stats)
+    for key, row in costed.items():
+        got = costs.default_ledger.row(key)
+        assert got is not None and got["source"] == "cache", key
+        assert got["flops"] == row["flops"]

@@ -1,0 +1,164 @@
+"""Per-lane solver telemetry: the packed [lanes, 4] diagnostics rows.
+
+The fused sweep computes iterations / chords / residual decade /
+rescue-strategy per lane INSIDE the device program, so lane-resolution
+telemetry rides the existing single "fused tail bundle" sync (the sync
+budget is pinned by tests/test_sync_budget.py). These tests pin the
+content contracts: the packed columns agree with the result arrays the
+sweep already returns, the device pack and the host-side failure-path
+twin encode residual decades identically, rescue codes land only on
+rescued lanes (quarantine stamped last), the fused and legacy
+(``PYCATKIN_FUSED_SWEEP=0``) paths produce bit-identical telemetry,
+and the JAX-free renderer tables in obs/export.py can never drift from
+the solver's code registry.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.obs import export, metrics
+from pycatkin_tpu.parallel import batch
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.solvers import newton
+from pycatkin_tpu.solvers.newton import SolverOptions
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=24, n_reactions=32)
+    spec = sim.spec
+    n = 32
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(420.0, 780.0, n))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask
+
+
+def test_export_strategy_table_matches_solver_registry():
+    """obs/export.py must stay importable without JAX, so it carries
+    its own copy of the strategy table -- this is the drift guard its
+    comment promises."""
+    assert len(export.STRATEGY_NAMES) == len(newton.STRATEGY_CODES)
+    for code, name in enumerate(export.STRATEGY_NAMES):
+        assert newton.STRATEGY_CODES[name] == code, name
+    assert len(export._STRATEGY_GLYPHS) == len(export.STRATEGY_NAMES)
+    assert newton.LANE_TELEMETRY_FIELDS == (
+        "iterations", "chords", "residual_decade", "strategy")
+
+
+def test_residual_decade_encoding():
+    dec = np.asarray(newton.residual_decade(jnp.asarray(
+        [1e-12, 5e-3, 0.0, np.nan, np.inf, 1e-120, 1e120])))
+    # floor(log10) per lane; -99 = exact zero, +99 = non-finite, both
+    # clips land inside the +-99 band.
+    np.testing.assert_array_equal(dec, [-12, -3, -99, 99, 99, -99, 99])
+    assert dec.dtype == np.int32
+
+
+def test_clean_sweep_telemetry_matches_result_arrays(problem):
+    spec, conds, mask = problem
+    metrics.reset()
+    out = sweep_steady_state(spec, conds, tof_mask=mask)
+    assert bool(np.all(np.asarray(out["success"]))), \
+        "corpus must converge cleanly for this test to mean anything"
+    n = np.asarray(conds.T).shape[0]
+    tel = np.asarray(out["lane_telemetry"])
+    assert tel.shape == (n, 4) and tel.dtype == np.int32
+    np.testing.assert_array_equal(
+        tel[:, 0], np.asarray(out["iterations"]).astype(np.int32))
+    want_ch = (np.asarray(out["chords"]).astype(np.int32)
+               if "chords" in out else np.zeros(n, np.int32))
+    np.testing.assert_array_equal(tel[:, 1], want_ch)
+    np.testing.assert_array_equal(
+        tel[:, 2],
+        np.asarray(newton.residual_decade(jnp.asarray(out["residual"]))))
+    np.testing.assert_array_equal(tel[:, 3], 0)   # nothing was rescued
+
+    # The pack fed the per-lane histograms, labeled by ABI bucket.
+    hists = metrics.snapshot()["histograms"]
+    for name in ("pycatkin_lane_iterations", "pycatkin_lane_chords",
+                 "pycatkin_lane_residual_decade"):
+        assert name in hists, name
+        assert sum(s["count"] for s in hists[name].values()) >= n
+
+    # And the JSON/heatmap renderers accept the pack as-is.
+    s = export.lane_summary(tel)
+    assert s["lanes"] == n
+    assert sum(s["strategies"].values()) == n
+    assert s["strategies"] == {"clean": n}
+    assert s["iterations"]["total"] == int(tel[:, 0].sum())
+    heat = export.format_lane_heatmap(tel, width=16)
+    assert "lane strategy heatmap" in heat and "." in heat
+
+
+def test_fused_and_legacy_telemetry_bit_identical(problem, monkeypatch):
+    spec, conds, mask = problem
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    fused = sweep_steady_state(spec, conds, tof_mask=mask)
+    monkeypatch.setenv("PYCATKIN_FUSED_SWEEP", "0")
+    legacy = sweep_steady_state(spec, conds, tof_mask=mask)
+    a = np.asarray(fused["lane_telemetry"])
+    b = np.asarray(legacy["lane_telemetry"])
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert a.tobytes() == b.tobytes(), \
+        "fused/legacy sweeps disagree on the packed lane telemetry"
+
+
+def test_rescue_path_stamps_strategy_codes(problem):
+    """Crippled pacing fails real lanes in the fast pass; the rescue
+    merge must stamp ladder codes on exactly the rescued lanes while
+    fast-pass survivors keep code 0 and quarantined lanes read 6."""
+    spec, conds, mask = problem
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    n = np.asarray(conds.T).shape[0]
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    fast = batch._steady_program(spec, batch._fast_pass_opts(opts))(
+        conds, keys, None)
+    fast_ok = np.asarray(fast.success)
+    assert np.any(~fast_ok), \
+        "corpus produced no failed lanes -- rescue path not exercised"
+
+    out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts)
+    tel = np.asarray(out["lane_telemetry"])
+    strat = tel[:, 3]
+    quar = np.asarray(out["quarantined"]).astype(bool)
+
+    assert set(np.unique(strat)) <= set(newton.STRATEGY_CODES.values())
+    np.testing.assert_array_equal(
+        strat[fast_ok & ~quar], newton.STRATEGY_CODES["clean"])
+    rescued = ~fast_ok & np.asarray(out["success"]) & ~quar
+    assert np.any(strat >= 1), "no lane carries a rescue code"
+    assert np.all(strat[rescued] >= 1), \
+        "a rescued lane still reads clean"
+    np.testing.assert_array_equal(
+        strat[quar], newton.STRATEGY_CODES["quarantine"])
+
+    # The failure-path (host-twin) columns still agree with the merged
+    # result arrays -- same contract as the clean device pack.
+    np.testing.assert_array_equal(
+        tel[:, 0], np.asarray(out["iterations"]).astype(np.int32))
+    if "chords" in out:
+        np.testing.assert_array_equal(
+            tel[:, 1], np.asarray(out["chords"]).astype(np.int32))
+    np.testing.assert_array_equal(
+        tel[:, 2],
+        np.asarray(newton.residual_decade(jnp.asarray(out["residual"]))))
+
+    s = export.lane_summary(tel)
+    assert s["lanes"] == n
+    assert any(name != "clean" for name in s["strategies"])
+
+
+def test_lane_rows_reject_malformed_telemetry():
+    with pytest.raises(ValueError, match="expected 4"):
+        export.lane_summary([[1, 2, 3]])
+    assert export.lane_summary([]) == {"lanes": 0}
+    # Out-of-table codes render as '?' / 'codeN' instead of crashing.
+    tel = [[3, 0, -8, 42]]
+    assert export.lane_summary(tel)["strategies"] == {"code42": 1}
+    assert "?" in export.format_lane_heatmap(tel)

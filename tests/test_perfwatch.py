@@ -1,0 +1,139 @@
+"""Perf-regression sentinel: obs/history.py math + tools/perfwatch CLI.
+
+The sentinel's one job is telling regression from noise: baselines are
+median +/- MAD (one flaky round cannot drag them), a finding needs BOTH
+the MAD band and the relative floor cleared in the BAD direction, and
+short histories stay silent rather than guessing. These tests pin that
+math directly on hand-built series, the BENCH_r*.json ingest (wrapped
+``{"parsed": ...}`` records, junk files skipped, mfu pulled from the
+cost-ledger totals), the dominant-span + cost-ledger attribution, and
+the CLI's exit codes -- ``--check`` is a CI gate, so exit 1 must mean
+exactly "the newest round regressed beyond noise".
+"""
+
+import json
+import os
+
+import pytest
+
+from pycatkin_tpu.obs import history as hist
+from tools.perfwatch import _synthetic_round, main
+
+
+# -- baseline / extraction math ---------------------------------------
+
+def test_baseline_is_robust_to_one_flaky_round():
+    b = hist.baseline([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert b == {"median": 3.0, "mad": 1.0, "n": 5}
+    b = hist.baseline([1.0, 3.0])
+    assert b["median"] == 2.0 and b["n"] == 2
+    assert hist.baseline([]) is None
+
+
+def test_extract_metrics_unwraps_and_falls_back_to_ledger_mfu():
+    rec = {"parsed": {"value": 100.0, "max_over_median": "not-a-number",
+                      "cost_ledger": {"totals": {"mfu": 0.25}}}}
+    m = hist.extract_metrics(rec)
+    assert m == {"value": 100.0, "mfu": 0.25}
+    # An explicit top-level mfu wins over the ledger fallback.
+    assert hist.extract_metrics({"value": 1.0, "mfu": 0.5})["mfu"] == 0.5
+    assert hist.extract_metrics("garbage") == {}
+
+
+def _entries(values, metric="value"):
+    return [{"metrics": {metric: v}} for v in values]
+
+
+def test_flag_regressions_noise_band_and_direction():
+    history = _entries([1000.0, 1012.0, 991.0, 1005.0, 997.0, 1008.0])
+    assert hist.flag_regressions(history, {"value": 994.0}) == []
+    found = hist.flag_regressions(history, {"value": 500.0})
+    assert len(found) == 1
+    f = found[0]
+    assert f["metric"] == "value" and f["direction"] == "higher"
+    assert f["ratio"] == pytest.approx(500.0 / f["median"], abs=1e-3)
+    assert f["n_history"] == 6
+    # Improvement in a higher-is-better metric: never a finding.
+    assert hist.flag_regressions(history, {"value": 2000.0}) == []
+    # Lower-is-better metric doubling IS a finding; halving is not.
+    low = _entries([2.0, 2.1, 1.9, 2.05], metric="prewarm_warm_s")
+    assert hist.flag_regressions(low, {"prewarm_warm_s": 4.5})
+    assert hist.flag_regressions(low, {"prewarm_warm_s": 1.0}) == []
+
+
+def test_flag_regressions_min_history_and_rel_floor_gates():
+    history = _entries([1000.0, 1012.0, 991.0, 1005.0, 997.0, 1008.0])
+    assert hist.flag_regressions(history[:2], {"value": 500.0}) == []
+    # Dead-quiet history (MAD = 0): the relative floor guards against
+    # flagging every rounding wobble.
+    quiet = _entries([1000.0] * 5)
+    assert hist.flag_regressions(quiet, {"value": 950.0}) == []
+    assert hist.flag_regressions(quiet, {"value": 880.0})
+    # A wider floor silences even a real-looking drop.
+    assert hist.flag_regressions(quiet, {"value": 880.0},
+                                 rel_floor=0.2) == []
+
+
+def test_attribution_names_span_and_program_drops():
+    prior = {"record": {"cost_ledger": {"programs": {
+        "fused-key": {"label": "fused sweep", "mfu": 0.30},
+        "tof-key": {"label": "tof", "mfu": 0.10}}}},
+        "metrics": {"value": 1000.0}}
+    cand = {"value": 500.0,
+            "outlier_span": {"label": "device sweep", "extra_s": 0.8,
+                             "trial": 3},
+            "cost_ledger": {"programs": {
+                "fused-key": {"label": "fused sweep", "mfu": 0.12},
+                "tof-key": {"label": "tof", "mfu": 0.11}}}}
+    attr = hist.attribute_regression(cand, [prior])
+    # Only the forensic fields ride along, and only the MFU DROPS are
+    # blamed (tof improved), worst ratio first.
+    assert attr["dominant_span"] == {"label": "device sweep",
+                                     "extra_s": 0.8}
+    drops = attr["cost_ledger_drops"]
+    assert [d["key"] for d in drops] == ["fused-key"]
+    assert drops[0]["ratio"] == pytest.approx(0.4)
+    # Bare candidates degrade to an empty attribution, never raise.
+    assert hist.attribute_regression({}, []) == {}
+
+
+def test_load_history_orders_rounds_and_skips_junk(tmp_path):
+    for i, v in ((3, 991.0), (1, 1000.0), (2, 1012.0)):
+        with open(tmp_path / f"BENCH_r{i}.json", "w") as fh:
+            json.dump(_synthetic_round(i, v, mfu=0.3, prewarm=2.0), fh)
+    (tmp_path / "BENCH_r9.json").write_text("{torn json")
+    (tmp_path / "notes.json").write_text("{}")
+    entries = hist.load_history(str(tmp_path))
+    assert [e["round"] for e in entries] == [1, 2, 3]
+    assert all("mfu" in e["metrics"] for e in entries)
+    assert entries[0]["metrics"]["value"] == 1000.0
+
+
+# -- the CLI face (make perfwatch / the CI lane) ----------------------
+
+def _write_rounds(root, values, start=1):
+    for i, v in enumerate(values, start=start):
+        with open(os.path.join(str(root), f"BENCH_r{i}.json"),
+                  "w", encoding="utf-8") as fh:
+            json.dump(_synthetic_round(i, v, mfu=0.30, prewarm=2.0), fh)
+
+
+def test_cli_selftest_passes():
+    assert main(["--selftest"]) == 0
+
+
+def test_cli_check_exit_codes(tmp_path, capsys):
+    # Too-short history: trivially PASS -- a young repo must not fail CI.
+    _write_rounds(tmp_path, [1000.0, 1012.0])
+    assert main(["--check", "--root", str(tmp_path)]) == 0
+    assert "PASS (trivially)" in capsys.readouterr().out
+
+    # In-noise newest round: PASS.
+    _write_rounds(tmp_path, [991.0, 1005.0, 997.0], start=3)
+    assert main(["--check", "--root", str(tmp_path)]) == 0
+    assert "no regression beyond noise" in capsys.readouterr().out
+
+    # Injected 2x regression in the newest round: exit 1, named metric.
+    _write_rounds(tmp_path, [500.0], start=6)
+    assert main(["--check", "--root", str(tmp_path)]) == 1
+    assert "REGRESSION value" in capsys.readouterr().out

@@ -11,7 +11,13 @@ this CLI can never disagree.
 Usage::
 
     python tools/obsview.py RUN.trace.json [--top N]
+    python tools/obsview.py --lanes SWEEP.json
     python tools/obsview.py --selftest [--sweep]
+
+``--lanes`` renders the per-lane solver telemetry heatmap (iteration /
+chord / residual-decade / rescue-strategy, one glyph per lane) from any
+JSON file carrying a packed ``lane_telemetry`` array -- a bench record
+or a dumped sweep output.
 
 ``--selftest`` is the ``make obs-check`` CI lane: it round-trips a
 programmatic trace through the Chrome exporter, verifies parenting,
@@ -104,8 +110,21 @@ def selftest(sweep: bool = False) -> int:
     if man.get("schema") != "pycatkin-run-manifest/v1":
         return _fail(f"manifest schema drifted: {man.get('schema')}")
 
+    # 5. Lane telemetry heatmap on synthetic packed rows.
+    from pycatkin_tpu.obs import format_lane_heatmap, lane_summary
+    tel = [[4, 0, -10, 0], [9, 3, -8, 2], [30, 6, -3, 6],
+           [5, 0, -11, 0]]
+    s = lane_summary(tel)
+    if (s["lanes"] != 4 or s["strategies"].get("quarantine") != 1
+            or s["iterations"]["max"] != 30):
+        return _fail(f"lane summary wrong: {s}")
+    heat = format_lane_heatmap(tel, width=2)
+    if ".t" not in heat or "#." not in heat:
+        return _fail(f"lane heatmap glyphs wrong:\n{heat}")
+    print(heat)
+
     if sweep:
-        # 5. A real (tiny, CPU-friendly) sweep under a run trace: the
+        # 6. A real (tiny, CPU-friendly) sweep under a run trace: the
         #    exported trace must reproduce the counted sync labels --
         #    on the fused clean path that is exactly one, the packed
         #    "fused tail bundle".
@@ -116,7 +135,11 @@ def selftest(sweep: bool = False) -> int:
         conds = broadcast_conditions(sim.conditions(), 8)
         with run_trace("obsview-sweep") as tr2:
             with profiling.sync_budget() as budget:
-                sweep_steady_state(sim.spec, conds)
+                out = sweep_steady_state(sim.spec, conds)
+        lane_tel = out.get("lane_telemetry")
+        if lane_tel is None or len(lane_tel) != 8:
+            return _fail("sweep output lost its per-lane telemetry")
+        print(format_lane_heatmap(lane_tel))
         with tempfile.TemporaryDirectory(prefix="obsview_") as tmp:
             path = os.path.join(tmp, "sweep.trace.json")
             write_chrome_trace(path, tr2)
@@ -138,6 +161,39 @@ def selftest(sweep: bool = False) -> int:
     return 0
 
 
+def _find_lane_telemetry(obj):
+    """Depth-first hunt for a 'lane_telemetry' array in a JSON object
+    (bench records nest the sweep output; BENCH_r*.json wraps it again
+    under 'parsed')."""
+    if isinstance(obj, dict):
+        tel = obj.get("lane_telemetry")
+        if tel is not None:
+            return tel
+        for v in obj.values():
+            tel = _find_lane_telemetry(v)
+            if tel is not None:
+                return tel
+    return None
+
+
+def lanes_view(path: str) -> int:
+    from pycatkin_tpu.obs import format_lane_heatmap
+    try:
+        with open(path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        return _fail(str(e))
+    tel = _find_lane_telemetry(obj)
+    if tel is None:
+        return _fail(f"{path}: no 'lane_telemetry' array anywhere in "
+                     f"the JSON")
+    try:
+        print(format_lane_heatmap(tel))
+    except (TypeError, ValueError) as e:
+        return _fail(f"{path}: malformed lane telemetry ({e})")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="obsview.py",
@@ -145,6 +201,9 @@ def main(argv=None) -> int:
     ap.add_argument("trace", nargs="?", help="trace JSON file")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest-span count in the summary tail")
+    ap.add_argument("--lanes", metavar="JSON",
+                    help="render the per-lane telemetry heatmap from "
+                         "a JSON file carrying 'lane_telemetry'")
     ap.add_argument("--selftest", action="store_true",
                     help="run the obs-check self-test instead of "
                          "reading a trace")
@@ -155,8 +214,10 @@ def main(argv=None) -> int:
 
     if args.selftest:
         return selftest(sweep=args.sweep)
+    if args.lanes:
+        return lanes_view(args.lanes)
     if not args.trace:
-        ap.error("need a trace file (or --selftest)")
+        ap.error("need a trace file (or --lanes / --selftest)")
 
     from pycatkin_tpu.obs import format_span_table, load_trace
     try:

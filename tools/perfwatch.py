@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""perfwatch: the perf-regression sentinel over BENCH round history.
+
+Ingests the ``BENCH_r*.json`` records the driver checks in every round
+into a rolling history, computes noise-aware baselines (median +/- MAD
+per tracked metric) and flags any metric of the newest round that sits
+beyond the noise band in the bad direction -- with dominant-span and
+cost-ledger attribution when the records carry forensics. All the math
+lives in :mod:`pycatkin_tpu.obs.history`; this is the CLI face.
+
+Usage::
+
+    python tools/perfwatch.py --check [--root DIR] [--mad-k K]
+                              [--rel-floor F] [--min-history N]
+    python tools/perfwatch.py --selftest
+
+``--check`` is the ``make perfwatch`` / CI lane: exit 1 when the newest
+round regressed throughput/MFU/prewarm beyond noise, exit 0 (with a
+note) when the history is still too short to call anything a
+regression. ``--selftest`` proves the sentinel on deterministic
+synthetic history: an injected 2x throughput regression MUST be
+flagged, an in-noise wobble MUST NOT.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fail(msg: str) -> int:
+    print(f"perfwatch: FAIL -- {msg}", file=sys.stderr, flush=True)
+    return 1
+
+
+def _print_findings(findings: list):
+    for f in findings:
+        arrow = "below" if f["direction"] == "higher" else "above"
+        print(f"perfwatch: REGRESSION {f['metric']}: "
+              f"{f['value']:.6g} is {arrow} baseline "
+              f"{f['median']:.6g} (+/- band {f['band']:.3g}, "
+              f"n={f['n_history']}, ratio {f['ratio']})")
+        attr = f.get("attribution") or {}
+        span = attr.get("dominant_span")
+        if span:
+            print(f"perfwatch:   dominant span: {span.get('label')} "
+                  f"(+{span.get('extra_s')}s)")
+        for d in attr.get("cost_ledger_drops", []):
+            print(f"perfwatch:   program slowdown: "
+                  f"{d.get('label') or d['key']} "
+                  f"(mfu ratio {d['ratio']})")
+
+
+def check(root: str, mad_k: float, rel_floor: float,
+          min_history: int) -> int:
+    from pycatkin_tpu.obs import history as hist
+    entries = hist.load_history(root)
+    if len(entries) < min_history + 1:
+        print(f"perfwatch: only {len(entries)} round(s) under {root}; "
+              f"need {min_history + 1} to judge -- PASS (trivially)")
+        return 0
+    *past, newest = entries
+    findings = hist.flag_regressions(
+        past, newest["record"], mad_k=mad_k,
+        rel_floor=rel_floor, min_history=min_history)
+    base_note = ", ".join(
+        f"{m}={v:.6g}" for m, v in sorted(newest["metrics"].items()))
+    print(f"perfwatch: round {newest['round']} "
+          f"({os.path.basename(newest['path'])}) vs {len(past)} prior "
+          f"round(s): {base_note or 'no tracked metrics'}")
+    if findings:
+        _print_findings(findings)
+        return 1
+    print("perfwatch: no regression beyond noise -- PASS")
+    return 0
+
+
+def _synthetic_round(i: int, value: float, mfu: float,
+                     prewarm: float) -> dict:
+    """One BENCH_r*.json body shaped like the driver's check-ins:
+    the bench JSON line wrapped under {"parsed": ...}, with a small
+    cost-ledger so attribution has something to join."""
+    return {"parsed": {
+        "bench": "volcano_sweep", "value": value, "unit": "pts/s",
+        "prewarm_warm_s": prewarm, "max_over_median": 1.02,
+        "cost_ledger": {
+            "totals": {"mfu": mfu},
+            "programs": {"fused-key": {"label": "fused sweep",
+                                       "mfu": mfu}},
+        },
+    }}
+
+
+def selftest() -> int:
+    from pycatkin_tpu.obs import history as hist
+
+    # 1. Baseline math on a known series (odd and even lengths).
+    b = hist.baseline([1.0, 2.0, 3.0, 4.0, 100.0])
+    if b["median"] != 3.0 or b["mad"] != 1.0:
+        return _fail(f"baseline math wrong: {b}")
+    b = hist.baseline([1.0, 3.0])
+    if b["median"] != 2.0 or b["n"] != 2:
+        return _fail(f"even-length baseline wrong: {b}")
+
+    # 2. Deterministic synthetic history through the real file-ingest
+    #    path: 6 rounds of in-noise wobble around 1000 pts/s.
+    wobble = [1000.0, 1012.0, 991.0, 1005.0, 997.0, 1008.0]
+    with tempfile.TemporaryDirectory(prefix="perfwatch_") as tmp:
+        for i, v in enumerate(wobble, start=1):
+            body = _synthetic_round(i, v, mfu=0.30 + 0.002 * (i % 3),
+                                    prewarm=2.0 + 0.05 * (i % 2))
+            with open(os.path.join(tmp, f"BENCH_r{i}.json"), "w",
+                      encoding="utf-8") as fh:
+                json.dump(body, fh)
+        history = hist.load_history(tmp)
+    if [e["round"] for e in history] != [1, 2, 3, 4, 5, 6]:
+        return _fail("load_history lost or misordered rounds")
+    if any("mfu" not in e["metrics"] for e in history):
+        return _fail("mfu not extracted from cost_ledger totals")
+
+    # 3. An in-noise candidate must NOT be flagged.
+    calm = _synthetic_round(7, 994.0, mfu=0.301, prewarm=2.03)
+    findings = hist.flag_regressions(history, calm)
+    if findings:
+        return _fail(f"in-noise wobble falsely flagged: {findings}")
+
+    # 4. An injected 2x throughput (and MFU) regression MUST be
+    #    flagged, and the attribution must name the span and program.
+    slow = _synthetic_round(7, 500.0, mfu=0.15, prewarm=2.0)
+    slow["parsed"]["outlier"] = {"label": "device sweep",
+                                 "extra_s": 0.8}
+    findings = hist.flag_regressions(history, slow)
+    flagged = {f["metric"] for f in findings}
+    if "value" not in flagged or "mfu" not in flagged:
+        return _fail(f"injected 2x regression missed: "
+                     f"flagged={sorted(flagged)}")
+    attr = findings[0]["attribution"]
+    if (attr.get("dominant_span", {}).get("label") != "device sweep"
+            or not attr.get("cost_ledger_drops")):
+        return _fail(f"regression attribution incomplete: {attr}")
+    _print_findings(findings)
+
+    # 5. Direction sanity: a lower-is-better metric doubling is bad,
+    #    a throughput IMPROVEMENT is not.
+    bloated = _synthetic_round(7, 1003.0, mfu=0.30, prewarm=4.5)
+    flagged = {f["metric"]
+               for f in hist.flag_regressions(history, bloated)}
+    if flagged != {"prewarm_warm_s"}:
+        return _fail(f"direction handling wrong: {sorted(flagged)}")
+    fast = _synthetic_round(7, 2000.0, mfu=0.45, prewarm=2.0)
+    if hist.flag_regressions(history, fast):
+        return _fail("an improvement was flagged as a regression")
+
+    # 6. Short history must stay silent (min_history gate).
+    if hist.flag_regressions(history[:2], slow):
+        return _fail("2-round history produced a verdict")
+
+    print("perfwatch: selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    from pycatkin_tpu.obs.history import DEFAULT_MAD_K, DEFAULT_REL_FLOOR
+    ap = argparse.ArgumentParser(
+        prog="perfwatch.py",
+        description="noise-aware perf-regression sentinel over "
+                    "BENCH_r*.json history")
+    ap.add_argument("--check", action="store_true",
+                    help="judge the newest round against the prior "
+                         "rounds' baseline (CI lane; exit 1 on "
+                         "regression)")
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root)")
+    ap.add_argument("--mad-k", type=float, default=DEFAULT_MAD_K,
+                    help="noise band width in MADs")
+    ap.add_argument("--rel-floor", type=float,
+                    default=DEFAULT_REL_FLOOR,
+                    help="minimum relative change to flag (guards "
+                         "dead-quiet histories)")
+    ap.add_argument("--min-history", type=int, default=3,
+                    help="baseline samples required before judging")
+    ap.add_argument("--selftest", action="store_true",
+                    help="prove the sentinel on synthetic history "
+                         "(CI lane)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.check:
+        return check(args.root, args.mad_k, args.rel_floor,
+                     args.min_history)
+    ap.error("need --check or --selftest")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
