@@ -22,14 +22,17 @@
 # regression flagged, in-noise wobble not), then --check judges the
 # newest checked-in BENCH_r*.json round against the prior rounds'
 # median +/- MAD baseline and hard-fails on a throughput/MFU
-# regression.
+# regression. `chaos` is the elastic-scheduler drill
+# (docs/failure_model.md): a small lease-scheduled multi-process sweep
+# with an injected worker crash that must finish with zero lost lanes
+# and at least one supervised restart.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-all lint \
 	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest \
-	obs-check perfwatch
+	obs-check perfwatch chaos
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -78,3 +81,7 @@ obs-check:
 perfwatch:
 	env JAX_PLATFORMS=cpu python tools/perfwatch.py --selftest
 	env JAX_PLATFORMS=cpu python tools/perfwatch.py --check
+
+chaos:
+	env JAX_PLATFORMS=cpu python -m pycatkin_tpu.robustness.scheduler \
+		--drill

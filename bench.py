@@ -775,6 +775,18 @@ def smoke_main():
     lane_telemetry_ok = (lane_tel is not None and len(lane_tel) == n
                          and lane_obs >= n)
 
+    # Elastic chaos gate (ISSUE-10): a small lease-scheduled sweep
+    # with an injected worker-crash must complete with zero lost lanes
+    # and at least one supervised restart. The fault plan travels via
+    # the WORKER environment only, so the manifest env gate below
+    # (which audits this process's PYCATKIN_* vars) stays clean.
+    from pycatkin_tpu.robustness.scheduler import chaos_drill
+    try:
+        elastic = chaos_drill()
+        elastic_ok = bool(elastic["ok"])
+    except Exception as e:  # noqa: BLE001 - gate reports, then fails
+        elastic, elastic_ok = {"error": str(e)}, False
+
     manifest = run_manifest()
     set_knobs = sorted(k for k in os.environ
                        if k.startswith("PYCATKIN_"))
@@ -813,6 +825,8 @@ def smoke_main():
         "cost_ledger_programs": len(led_rows),
         "mfu": (cost_ledger.get("totals") or {}).get("mfu"),
         "lane_telemetry_ok": lane_telemetry_ok,
+        "elastic_ok": elastic_ok,
+        "elastic": elastic,
         "lanes": (lane_summary(lane_tel) if lane_tel is not None
                   else None),
         # Small enough at 8x8 to ship whole; tools/obsview.py --lanes
@@ -845,6 +859,9 @@ def smoke_main():
         log(f"bench-smoke: FAIL -- lane telemetry gate: bundle "
             f"{'missing' if lane_tel is None else len(lane_tel)}, "
             f"histogram observed {lane_obs}/{n} lanes")
+        return 1
+    if not elastic_ok:
+        log(f"bench-smoke: FAIL -- elastic chaos gate: {elastic}")
         return 1
     if not abi_zero_compile_ok:
         log(f"bench-smoke: FAIL -- second mechanism in the warm ABI "

@@ -323,3 +323,61 @@ def format_lane_heatmap(lane_telemetry, width: int = 64) -> str:
                      + "  ".join(f"{k}:{v}" for k, v
                                  in s["strategies"].items()))
     return "\n".join(lines)
+
+
+# -- elastic worker lifecycle (events = the kind="worker" records the
+#    scheduler appends to events.jsonl / report["events"]) -------------
+
+def worker_summary(events) -> dict:
+    """Aggregate an elastic run's worker-lifecycle events: counts per
+    action plus restart totals per worker -- the ``--workers`` header
+    line. Pure host-side; tolerant of mixed event streams (non-worker
+    kinds are ignored)."""
+    evs = [e for e in (events or []) if e.get("kind") == "worker"]
+    actions: dict[str, int] = {}
+    restarts: dict[str, int] = {}
+    for e in evs:
+        act = str(e.get("action", "?"))
+        actions[act] = actions.get(act, 0) + 1
+        if act == "restart":
+            lbl = str(e.get("label", "?"))
+            restarts[lbl] = restarts.get(lbl, 0) + 1
+    return {"n_events": len(evs), "actions": actions,
+            "restarts": restarts}
+
+
+def format_worker_timeline(events) -> str:
+    """Chronological rendering of the lease/restart lifecycle: one line
+    per worker event, timestamped relative to the first (the scheduler
+    stamps wall-clock ``t``), action-aligned so spawn/exit/steal
+    cascades read top to bottom::
+
+          +0.000s  worker:0        spawn        pid=1234 incarnation=0
+          +2.143s  worker:0        exit         signal-death (rc=-9)
+          +2.150s  lease:t00000_4  task-killed  kills=1
+    """
+    evs = [e for e in (events or []) if e.get("kind") == "worker"]
+    if not evs:
+        return "no worker lifecycle events"
+    known = [e for e in evs if isinstance(e.get("t"), (int, float))]
+    t0 = min((e["t"] for e in known), default=0.0)
+    lines = []
+    s = worker_summary(evs)
+    lines.append(f"worker lifecycle: {s['n_events']} event(s); "
+                 + "  ".join(f"{k}:{v}"
+                             for k, v in sorted(s["actions"].items())))
+    for e in evs:
+        t = e.get("t")
+        stamp = (f"+{t - t0:8.3f}s" if isinstance(t, (int, float))
+                 else " " * 10)
+        extra = []
+        for key in ("pid", "incarnation", "returncode", "exit_kind",
+                    "kills", "cause", "owner", "stolen_from", "mid",
+                    "children", "attempt", "delay_s", "restarts",
+                    "task", "n_failed", "detail", "lanes"):
+            if key in e and e[key] is not None:
+                extra.append(f"{key}={e[key]}")
+        lines.append(f"  {stamp}  {str(e.get('label', '?')):<18} "
+                     f"{str(e.get('action', e.get('rung', '?'))):<16} "
+                     + " ".join(extra))
+    return "\n".join(lines)

@@ -63,7 +63,8 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                    worker_env: Optional[dict] = None,
                    timeout: Optional[float] = None,
                    on_failure: str = "raise",
-                   aot_cache: Optional[str] = None) -> dict:
+                   aot_cache: Optional[str] = None,
+                   mode: str = "static", **elastic_opts) -> dict:
     """Run ``sweep_steady_state`` over ``conds`` split across
     ``n_workers`` independent processes; returns the merged result dict
     (same keys as the in-process sweep, lane order preserved).
@@ -91,11 +92,34 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
     only, a miss costs nothing), so N workers don't each recompile
     programs some earlier run already built. None inherits the
     parent's environment unchanged.
+
+    ``mode="elastic"`` swaps the static split-and-wait protocol for
+    the lease-based elastic scheduler
+    (:func:`robustness.scheduler.run_elastic`): the grid becomes a
+    shared work queue, dead/stalled workers are restarted and their
+    leases stolen, and poison chunks are bisected down to quarantine
+    instead of failing the sweep. Extra keyword arguments
+    (``chunk``, ``ttl_s``, ``max_kills``, ...) pass through;
+    ``on_failure`` does not apply (degradation is per-span, built in).
     """
     import tempfile
 
     from ..utils.io import save_system_json
 
+    if mode not in ("static", "elastic"):
+        raise ValueError(f"mode must be 'static' or 'elastic', "
+                         f"got {mode!r}")
+    if mode == "elastic":
+        from ..robustness.scheduler import run_elastic
+        out, _report = run_elastic(
+            sim, conds, n_workers=n_workers, work_dir=work_dir,
+            tof_terms=tof_terms, check_stability=check_stability,
+            worker_env=worker_env, aot_cache=aot_cache,
+            timeout=timeout, **elastic_opts)
+        return out
+    if elastic_opts:
+        raise TypeError(f"unexpected keyword argument(s) for static "
+                        f"mode: {sorted(elastic_opts)}")
     if on_failure not in ("raise", "salvage"):
         raise ValueError(f"on_failure must be 'raise' or 'salvage', "
                          f"got {on_failure!r}")
@@ -129,10 +153,15 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
             env["PYCATKIN_AOT_CACHE"] = str(aot_cache)
         if worker_env:
             env.update({k: str(v) for k, v in worker_env.items()})
-        procs.append((i, out_path, subprocess.Popen(
-            [sys.executable, "-m", "pycatkin_tpu.parallel.dispatch",
-             cfg_path],
-            env=env, cwd=os.getcwd())))
+        # Workers write stderr to per-block log files so a failure can
+        # surface the actual traceback, not a bare returncode (and a
+        # retry storm in one worker doesn't interleave with another's).
+        stderr_path = os.path.join(work_dir, f"worker_{i}.stderr.log")
+        with open(stderr_path, "wb") as errf:
+            procs.append((i, out_path, subprocess.Popen(
+                [sys.executable, "-m", "pycatkin_tpu.parallel.dispatch",
+                 cfg_path],
+                env=env, cwd=os.getcwd(), stderr=errf)))
 
     failed = []
     # ``timeout`` is a SHARED deadline for the whole sweep, not a
@@ -147,10 +176,10 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                              if deadline is not None else None)
                 rc = p.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
-                failed.append(i)
+                failed.append((i, None, True))
                 continue
             if rc != 0 or not os.path.exists(out_path):
-                failed.append(i)
+                failed.append((i, rc, False))
     finally:
         # Never orphan workers: on timeout/failure/interrupt, terminate
         # whatever is still running before propagating.
@@ -166,7 +195,7 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
         from ..obs import metrics as _metrics
         from ..utils.profiling import record_event
         still_failed = []
-        for i in failed:
+        for i, rc, timed_out in failed:
             cfg_path = os.path.join(work_dir, f"job_{i}.json")
             record_event("degradation", label=f"dispatch:block:{i}",
                          rung="host-fallback",
@@ -183,12 +212,27 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
                 _metrics.counter(
                     "pycatkin_dispatch_abandoned_blocks_total",
                     "worker blocks abandoned after salvage failed").inc()
-                still_failed.append(i)
+                still_failed.append((i, rc, timed_out))
         failed = still_failed
     if failed:
+        # Classify each failure into the retry taxonomy and quote the
+        # worker's dying words -- "block 2 failed" with no cause costs
+        # a debugging round-trip into the work_dir every time.
+        from ..robustness.scheduler import stderr_tail
+        from ..utils.retry import classify_worker_exit
+        details = []
+        for i, rc, timed_out in failed:
+            info = classify_worker_exit(rc, timed_out=timed_out)
+            line = f"block {i}: {info.kind} ({info.detail})"
+            tail = stderr_tail(
+                os.path.join(work_dir, f"worker_{i}.stderr.log"))
+            if tail:
+                line += "; last stderr: " + " | ".join(tail[-3:])
+            details.append(line)
         raise RuntimeError(
-            f"dispatch_sweep: worker block(s) {failed} failed or timed "
-            f"out; inputs and any partial results are in {work_dir}")
+            "dispatch_sweep: worker block(s) failed or timed out -- "
+            + "; ".join(details)
+            + f"; inputs and any partial results are in {work_dir}")
 
     from ..utils.profiling import span
     merged: dict = {}

@@ -10,6 +10,9 @@ See docs/failure_model.md for the full failure model; the three layers:
   another device -> CPU host fallback -> salvage + structured report.
 - :mod:`.journal` / :mod:`.chunked` -- append-only sweep journal and
   the resumable chunked sweep runner built on it.
+- :mod:`.scheduler` -- elastic multi-process dispatch: lease-based
+  work queue, worker supervision/restart, poison-span bisection and
+  the chaos drill.
 """
 
 from .chunked import (chunk_verdict, chunked_sweep_steady_state,
@@ -18,9 +21,11 @@ from .faults import (FaultPlan, FaultSpec, InjectedDeviceLossError,
                      fault_scope)
 from .journal import (JournalMismatchError, SweepJournal,
                       conditions_fingerprint)
-from .forensics import format_failure_report, sweep_failure_report
+from .forensics import (format_failure_report, sweep_failure_report,
+                        worker_lifecycle)
 from .ladder import (ChunkAbandonedError, DegradationPolicy,
                      record_quarantine, run_chunk_with_ladder)
+from .scheduler import WorkQueue, chaos_drill, run_elastic
 
 __all__ = [
     "ChunkAbandonedError",
@@ -30,6 +35,8 @@ __all__ = [
     "InjectedDeviceLossError",
     "JournalMismatchError",
     "SweepJournal",
+    "WorkQueue",
+    "chaos_drill",
     "chunk_verdict",
     "chunked_sweep_steady_state",
     "conditions_fingerprint",
@@ -37,6 +44,8 @@ __all__ = [
     "format_failure_report",
     "record_quarantine",
     "run_chunk_with_ladder",
+    "run_elastic",
     "salvage_arrays",
     "sweep_failure_report",
+    "worker_lifecycle",
 ]

@@ -27,12 +27,34 @@ Faults:
   NaN, modeling silently corrupted chunk outputs.
 - ``stall``      -- sleeps ``delay_s`` before the call proceeds,
   modeling slow compiles / stalled transports for deadline tests.
+- ``worker-crash``    -- SIGKILLs the calling process, modeling a
+  preempted/OOM-killed elastic worker. Only meaningful inside a
+  subprocess worker (the elastic scheduler's ``worker:<i>`` /
+  ``lease:<i>`` sites); the supervisor observes the signal death and
+  requeues the lease.
+- ``heartbeat-stall`` -- sleeps ``delay_s`` at a heartbeat site
+  (``heartbeat:<i>``): the worker stays alive but stops renewing its
+  lease, so the supervisor must detect the expired lease and let
+  another worker steal the work.
+- ``slow-worker``     -- sleeps ``delay_s`` at a worker site: a
+  straggler that makes progress, just slowly, for work-stealing and
+  deadline drills.
 
 Activation: pass a plan to :func:`fault_scope` (tests), or set the
 ``PYCATKIN_FAULTS`` environment variable to the JSON list of fault
 specs (survives into subprocess workers, enabling end-to-end
 kill/resume drills). With no plan active every hook is a single
 ``is None`` check -- the production hot path pays nothing.
+
+Fleet-wide fault budgets: ``PYCATKIN_FAULTS`` may also be a JSON
+OBJECT ``{"specs": [...], "state_dir": "..."}``. With a ``state_dir``,
+each spec's ``times`` budget is enforced across EVERY process sharing
+that directory (ticket files created ``O_EXCL``, so concurrent workers
+race for firings atomically), not per process. This is what makes
+``worker-crash`` drills terminate: a restarted worker re-reads the
+same plan from its environment, but the already-consumed ticket stops
+it from dying again on every incarnation. ``index`` stays per-process
+(occurrence counters are local by design).
 """
 
 from __future__ import annotations
@@ -47,7 +69,8 @@ from dataclasses import dataclass
 
 ENV_VAR = "PYCATKIN_FAULTS"
 
-_KINDS = ("transient", "permanent", "nan", "stall")
+_KINDS = ("transient", "permanent", "nan", "stall",
+          "worker-crash", "heartbeat-stall", "slow-worker")
 
 
 class InjectedDeviceLossError(RuntimeError):
@@ -63,15 +86,19 @@ class FaultSpec:
 
     site:    fnmatch pattern against the injection-site label (retry
              labels like ``"batched steady solve"``, chunk sites like
-             ``"chunk:3"``; ``"chunk:*"`` matches every chunk).
-    kind:    'transient' | 'permanent' | 'nan' | 'stall'.
+             ``"chunk:3"``, elastic-scheduler sites like ``"worker:0"``
+             / ``"lease:t00004_00008"`` / ``"heartbeat:2"``;
+             ``"chunk:*"`` matches every chunk).
+    kind:    one of ``transient | permanent | nan | stall |
+             worker-crash | heartbeat-stall | slow-worker``.
     index:   fire only at this occurrence of the site (0-based count
              of calls at that site, retries included); None = any.
     times:   maximum number of firings (None = unlimited; a permanent
              device loss is typically ``times=None``).
     lanes:   for 'nan': lane indices (leading axis) to poison;
              None = every lane.
-    delay_s: for 'stall': seconds to sleep before the call proceeds.
+    delay_s: for 'stall'/'heartbeat-stall'/'slow-worker': seconds to
+             sleep before the call proceeds.
     """
     site: str
     kind: str
@@ -130,9 +157,10 @@ class FaultPlan:
     for test assertions.
     """
 
-    def __init__(self, specs=()):
+    def __init__(self, specs=(), state_dir: str | None = None):
         self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
                       for s in specs]
+        self.state_dir = None if state_dir is None else str(state_dir)
         self._calls: dict[str, int] = {}
         self._fired: dict[int, int] = {}
         self.log: list[dict] = []
@@ -141,12 +169,43 @@ class FaultPlan:
     @classmethod
     def from_env(cls, text: str | None = None) -> "FaultPlan | None":
         """Build a plan from ``PYCATKIN_FAULTS`` (JSON list of spec
-        dicts); None when the variable is unset/empty."""
+        dicts, or ``{"specs": [...], "state_dir": ...}`` for
+        fleet-wide budgets); None when the variable is unset/empty."""
         if text is None:
             text = os.environ.get(ENV_VAR, "")
         if not text.strip():
             return None
-        return cls(json.loads(text))
+        data = json.loads(text)
+        if isinstance(data, dict):
+            return cls(data.get("specs", ()),
+                       state_dir=data.get("state_dir"))
+        return cls(data)
+
+    def _acquire(self, i: int, spec: FaultSpec) -> bool:
+        """Consume one firing of spec ``i`` (called under the lock,
+        AFTER :meth:`_due` matched it). Per-process plans just count;
+        with a ``state_dir`` a bounded spec must win an ``O_EXCL``
+        ticket file, so at most ``times`` firings happen across every
+        process sharing the directory -- first-claimer-wins, no
+        cross-process lock needed."""
+        if self.state_dir is None or spec.times is None:
+            self._fired[i] = self._fired.get(i, 0) + 1
+            return True
+        os.makedirs(self.state_dir, exist_ok=True)
+        for k in range(spec.times):
+            path = os.path.join(self.state_dir, f"spec{i:03d}_fire{k:03d}")
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue
+            os.write(fd, f"{os.getpid()}\n".encode())
+            os.close(fd)
+            self._fired[i] = self._fired.get(i, 0) + 1
+            return True
+        # Budget exhausted fleet-wide: remember locally so _due stops
+        # offering this spec.
+        self._fired[i] = spec.times
+        return False
 
     def _due(self, site: str, occurrence: int, kinds) -> list[int]:
         due = []
@@ -170,19 +229,29 @@ class FaultPlan:
         with self._lock:
             occ = self._calls.get(site, 0)
             self._calls[site] = occ + 1
-            due = self._due(site, occ, ("stall", "transient", "permanent"))
+            due = self._due(site, occ,
+                            ("stall", "heartbeat-stall", "slow-worker",
+                             "worker-crash", "transient", "permanent"))
             fired = []
             for i in due:
-                self._fired[i] = self._fired.get(i, 0) + 1
                 spec = self.specs[i]
+                if not self._acquire(i, spec):
+                    continue
                 self.log.append({"site": site, "occurrence": occ,
                                  "kind": spec.kind})
                 fired.append(spec)
         # Act outside the lock (sleeps and raises must not serialize
         # other sites' bookkeeping).
         for spec in fired:
-            if spec.kind == "stall":
+            if spec.kind in ("stall", "heartbeat-stall", "slow-worker"):
                 time.sleep(spec.delay_s)
+            elif spec.kind == "worker-crash":
+                # Model an external SIGKILL (preemption / OOM-killer):
+                # the process dies mid-lease with no chance to clean
+                # up, which is exactly the failure the elastic
+                # scheduler's lease expiry + requeue must absorb.
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
             elif spec.kind == "transient":
                 raise _transient_error(site, occ)
             else:
@@ -200,7 +269,8 @@ class FaultPlan:
             due = self._due(site, occ, ("nan",))
             lanes = []
             for i in due:
-                self._fired[i] = self._fired.get(i, 0) + 1
+                if not self._acquire(i, self.specs[i]):
+                    continue
                 self.log.append({"site": site, "occurrence": occ,
                                  "kind": "nan"})
                 lanes.append(self.specs[i].lanes)

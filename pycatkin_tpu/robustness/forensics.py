@@ -89,6 +89,45 @@ def lane_report(out: dict, lane: int, conds=None,
     return rep
 
 
+def worker_lifecycle(events) -> dict:
+    """Summarize an elastic run's worker-lifecycle events (the
+    ``kind="worker"`` records of robustness/scheduler.py: supervisor
+    events from ``events.jsonl`` or ``report["events"]``) into the
+    forensic shape: how many restarts, which leases expired or were
+    stolen, which spans were bisected or quarantined -- the "who died
+    and what happened to their work" half of a degraded run.
+
+    Returns zeros/empties for runs with no worker events, so the
+    section folds into every report harmlessly."""
+    evs = [e for e in (events or []) if e.get("kind") == "worker"]
+    by_action: dict[str, list] = {}
+    for e in evs:
+        by_action.setdefault(e.get("action", "?"), []).append(e)
+
+    def labels(action):
+        return [e.get("label", "?") for e in by_action.get(action, [])]
+
+    restarts: dict[str, int] = {}
+    for e in by_action.get("restart", ()):
+        lbl = e.get("label", "?")
+        restarts[lbl] = restarts.get(lbl, 0) + 1
+    return {
+        "n_events": len(evs),
+        "restarts": restarts,
+        "n_restarts": sum(restarts.values()),
+        "spawns": len(by_action.get("spawn", ())),
+        "abandoned": labels("abandon"),
+        "killed_stalled": labels("kill-stalled"),
+        "leases_expired": labels("lease-expired"),
+        "leases_stolen": [
+            {"task": e.get("label"), "by": e.get("owner"),
+             "from": e.get("stolen_from")}
+            for e in by_action.get("lease-stolen", ())],
+        "bisected": labels("task-bisected"),
+        "quarantined": labels("task-quarantined"),
+    }
+
+
 def sweep_failure_report(out: dict, conds=None,
                          events: list | None = None,
                          max_lanes: int = 256) -> dict:
@@ -116,6 +155,10 @@ def sweep_failure_report(out: dict, conds=None,
         "lanes": [lane_report(out, int(i), conds=conds, events=events)
                   for i in bad[:max_lanes]],
         "events": list(events or []),
+        # Elastic runs thread their lifecycle events through the same
+        # ``events`` list, so the worker section costs nothing to
+        # always include.
+        "worker_lifecycle": worker_lifecycle(events),
         # Self-describing forensics: the run manifest records what
         # code/backend/knobs produced the failures being dissected.
         "manifest": run_manifest(),
@@ -157,4 +200,24 @@ def format_failure_report(report: dict) -> str:
     if report.get("truncated"):
         lines.append(f"  (lane reports truncated at "
                      f"{len(report['lanes'])})")
+    wl = report.get("worker_lifecycle") or {}
+    if wl.get("n_events"):
+        lines.append(f"  worker lifecycle: {wl['spawns']} spawn(s), "
+                     f"{wl['n_restarts']} restart(s)")
+        for lbl, cnt in sorted(wl.get("restarts", {}).items()):
+            lines.append(f"    {lbl}: restarted {cnt}x")
+        for lbl in wl.get("killed_stalled", []):
+            lines.append(f"    {lbl}: killed for stalled heartbeat")
+        for lbl in wl.get("leases_expired", []):
+            lines.append(f"    {lbl}: lease expired, requeued")
+        for st in wl.get("leases_stolen", []):
+            lines.append(f"    {st.get('task')}: stolen by "
+                         f"{st.get('by')} from {st.get('from')}")
+        for lbl in wl.get("bisected", []):
+            lines.append(f"    {lbl}: bisected and requeued")
+        for lbl in wl.get("quarantined", []):
+            lines.append(f"    {lbl}: quarantined at minimum size")
+        for lbl in wl.get("abandoned", []):
+            lines.append(f"    {lbl}: slot abandoned "
+                         f"(restart budget exhausted)")
     return "\n".join(lines)

@@ -33,8 +33,8 @@ from __future__ import annotations
 import hashlib
 import os
 
-from ..utils.io import (append_json_line, load_results, read_json_lines,
-                        save_results)
+from ..utils.io import (append_json_line, atomic_save_results,
+                        load_results, read_json_lines)
 
 MANIFEST = "journal.jsonl"
 _VERSION = 1
@@ -144,12 +144,10 @@ class SweepJournal:
                "events": list(events)}
         if arrays is not None:
             fname = f"chunk_{chunk_id:05d}.npz"
-            final = os.path.join(self.path, fname)
-            # Temp name keeps the .npz suffix (np.savez appends one to
-            # anything else, breaking the rename).
-            tmp = final[:-4] + ".tmp.npz"
-            save_results(tmp, **arrays)
-            os.replace(tmp, final)
+            # Write-then-rename (plus the PYCATKIN_JOURNAL_FSYNC
+            # durability knob) so this manifest line can never point
+            # at a torn payload, even for a worker killed mid-write.
+            atomic_save_results(os.path.join(self.path, fname), arrays)
             rec["npz"] = fname
         append_json_line(self.manifest_path, rec)
         self._records.append(rec)

@@ -33,8 +33,10 @@ test suite exercises each branch of this module deterministically.
 from __future__ import annotations
 
 import random
+import signal
 import sys
 import time
+from dataclasses import dataclass
 
 # Substrings identifying transport-layer / compile-service flakes, as
 # observed on the tunneled backend plus the standard gRPC transient
@@ -107,6 +109,62 @@ def is_transient_backend_error(exc: BaseException) -> bool:
     return any(marker in msg for marker in TRANSIENT_MARKERS)
 
 
+def backoff_delay(attempt: int, base_delay_s: float,
+                  max_delay_s: float, jitter: bool = True,
+                  rng=None) -> float:
+    """Full-jitter exponential backoff delay before retry ``attempt``
+    (0-based): ``U(0, min(max_delay_s, base_delay_s * 2**attempt)]``
+    when ``jitter`` is on, the deterministic cap otherwise. The one
+    backoff formula shared by the in-process retry wrapper below and
+    the elastic scheduler's worker-restart loop
+    (robustness/scheduler.py) -- synchronized workers recovering from
+    a shared failure must not stampede back in lockstep."""
+    delay = min(max_delay_s, base_delay_s * (2.0 ** attempt))
+    if jitter:
+        rng = rng if rng is not None else _jitter_rng
+        delay = rng.uniform(0.0, delay)
+    return delay
+
+
+@dataclass(frozen=True)
+class WorkerExit:
+    """Classification of one worker subprocess exit into the retry
+    taxonomy: ``transient`` deaths (signal-death, timeout) are
+    preemption-shaped -- re-dispatching the same block is pure, so a
+    requeue/restart is always safe, exactly like a transient backend
+    error in :func:`is_transient_backend_error`; a ``nonzero-exit`` is
+    a program error (a re-run of the identical input will likely die
+    again) and only counts toward poison-chunk bisection."""
+    kind: str          # "ok" | "signal-death" | "nonzero-exit" | "timeout"
+    transient: bool
+    detail: str
+
+
+def classify_worker_exit(returncode: int | None,
+                         timed_out: bool = False) -> WorkerExit:
+    """Map a subprocess return code (``Popen.returncode`` semantics:
+    negative = killed by that signal) onto the retry taxonomy."""
+    if timed_out:
+        return WorkerExit("timeout", True,
+                          "worker exceeded its deadline (treated like "
+                          "DEADLINE_EXCEEDED: requeue-safe)")
+    if returncode is None:
+        return WorkerExit("ok", False, "worker still running")
+    if returncode == 0:
+        return WorkerExit("ok", False, "clean exit")
+    if returncode < 0:
+        try:
+            name = signal.Signals(-returncode).name
+        except ValueError:
+            name = f"signal {-returncode}"
+        return WorkerExit("signal-death", True,
+                          f"killed by {name} (preemption-shaped: "
+                          f"requeue-safe)")
+    return WorkerExit("nonzero-exit", False,
+                      f"exit status {returncode} (program error: "
+                      f"re-run of the identical block may die again)")
+
+
 def call_with_backend_retry(fn, *args, attempts: int = 3,
                             base_delay_s: float = 2.0,
                             max_delay_s: float = 60.0,
@@ -145,9 +203,8 @@ def call_with_backend_retry(fn, *args, attempts: int = 3,
         except Exception as exc:  # noqa: BLE001 -- filtered below
             if i + 1 >= attempts or not is_transient_backend_error(exc):
                 raise
-            delay = min(max_delay_s, base_delay_s * (2.0 ** i))
-            if jitter:
-                delay = rng.uniform(0.0, delay)
+            delay = backoff_delay(i, base_delay_s, max_delay_s,
+                                  jitter=jitter, rng=rng)
             if deadline_s is not None and \
                     time.monotonic() - start + delay > deadline_s:
                 raise

@@ -1,0 +1,342 @@
+"""Elastic sweep scheduler: lease-based work queue, supervision, chaos
+(robustness/scheduler.py; docs/failure_model.md "The elastic
+scheduler").
+
+Three layers of proof:
+
+- unit tests against the pure lease/task math and the on-disk
+  :class:`WorkQueue` primitives (``now`` is always passed explicitly,
+  so nothing here sleeps);
+- the chaos drill: a real multi-process elastic sweep with two workers
+  SIGKILLed mid-chunk and a third's heartbeat stalled past the TTL,
+  whose merged result must be **bit-identical** to the undisturbed
+  in-process sweep of the same chunk grid;
+- the poison drill: a span that kills every worker touching it must be
+  bisected down to the minimum chunk and quarantined -- one lost lane,
+  never a lost sweep.
+
+The subprocess runs double as fixtures for the forensics
+worker-lifecycle section and the ``obsview --workers`` timeline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu.robustness.faults import FaultPlan
+from pycatkin_tpu.robustness.scheduler import (WorkQueue, bisect_span,
+                                               covering_spans,
+                                               lease_expired,
+                                               lease_record, parse_task_id,
+                                               run_elastic, task_id)
+from pycatkin_tpu.utils.retry import classify_worker_exit
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------
+# Pure lease/task math.
+
+def test_task_id_roundtrip():
+    assert task_id(4, 8) == "t00004_00008"
+    assert parse_task_id("t00004_00008") == (4, 8)
+    a, b = parse_task_id(task_id(0, 65536))
+    assert (a, b) == (0, 65536)
+
+
+def test_lease_expiry_math():
+    rec = lease_record("w0-123", ttl_s=30.0, now=1000.0)
+    assert rec["deadline"] == 1030.0
+    assert not lease_expired(rec, 1029.9)
+    assert lease_expired(rec, 1030.0)
+    assert lease_expired({}, 0.0)            # malformed = expired
+    stolen = lease_record("w1-456", 30.0, 1040.0, stolen_from="w0-123")
+    assert stolen["stolen_from"] == "w0-123"
+
+
+def test_bisect_floor():
+    assert bisect_span(0, 8, 4) == 4         # width exactly 2*min splits
+    assert bisect_span(0, 7, 4) is None      # a child would be < min
+    assert bisect_span(4, 6, 1) == 5
+    assert bisect_span(4, 5, 1) is None      # the quarantine floor
+    assert bisect_span(0, 4096, 1) == 2048
+
+
+def test_covering_spans_tiling_and_overlap():
+    def rec(a, b):
+        return {"start": a, "stop": b, "tid": task_id(a, b)}
+
+    assert covering_spans([rec(0, 4)], 8) is None          # gap at tail
+    assert covering_spans([rec(4, 8)], 8) is None          # gap at head
+    full = covering_spans([rec(4, 8), rec(0, 4)], 8)
+    assert [(a, b) for a, b, _ in full] == [(0, 4), (4, 8)]
+    # Parent/child duplicates (a stalled owner finishing the parent
+    # after its children were re-solved): widest span wins, in either
+    # input order.
+    for recs in ([rec(0, 8), rec(0, 4), rec(4, 8)],
+                 [rec(0, 4), rec(4, 8), rec(0, 8)]):
+        cover = covering_spans(recs, 8)
+        assert [(a, b) for a, b, _ in cover] == [(0, 8)]
+    # Partial overlap: child head already covered, tail still needed.
+    cover = covering_spans([rec(0, 6), rec(4, 8)], 8)
+    assert [(a, b) for a, b, _ in cover] == [(0, 6), (4, 8)]
+
+
+def test_classify_worker_exit_taxonomy():
+    ok = classify_worker_exit(0)
+    assert ok.kind == "ok" and not ok.transient
+    sig = classify_worker_exit(-9)
+    assert sig.kind == "signal-death" and sig.transient
+    rc = classify_worker_exit(3)
+    assert rc.kind == "nonzero-exit" and not rc.transient
+    to = classify_worker_exit(None, timed_out=True)
+    assert to.kind == "timeout" and to.transient
+
+
+# ---------------------------------------------------------------------
+# WorkQueue primitives (explicit `now`; no sleeping).
+
+def test_claim_is_first_wins(tmp_path):
+    q = WorkQueue(str(tmp_path)).setup()
+    tid = q.add_task(0, 4)
+    assert q.claim(tid, "w0-1", ttl_s=10.0, now=100.0)
+    assert not q.claim(tid, "w1-2", ttl_s=10.0, now=100.0)
+    assert q.read_lease(tid)["owner"] == "w0-1"
+
+
+def test_renew_is_fenced(tmp_path):
+    q = WorkQueue(str(tmp_path)).setup()
+    tid = q.add_task(0, 4)
+    q.claim(tid, "w0-1", ttl_s=10.0, now=100.0)
+    assert q.renew(tid, "w0-1", ttl_s=10.0, now=105.0)
+    assert q.read_lease(tid)["deadline"] == 115.0
+    assert not q.renew(tid, "w1-2", ttl_s=10.0, now=105.0)
+    # After a steal the old owner's renewal must report the loss.
+    q.requeue(tid)
+    q.claim(tid, "w1-2", ttl_s=10.0, now=106.0, stolen_from="w0-1")
+    assert not q.renew(tid, "w0-1", ttl_s=10.0, now=107.0)
+    assert q.read_lease(tid)["owner"] == "w1-2"
+
+
+def test_claim_next_steals_only_expired(tmp_path):
+    q = WorkQueue(str(tmp_path)).setup()
+    tid = q.add_task(0, 4)
+    q.claim(tid, "w0-1", ttl_s=1.0, now=100.0)
+    assert q.claim_next("w1-2", ttl_s=1.0, now=100.5) is None
+    got = q.claim_next("w1-2", ttl_s=1.0, now=102.0)
+    assert got == (tid, "w0-1")
+    assert q.read_lease(tid)["stolen_from"] == "w0-1"
+
+
+def test_done_record_is_exclusive(tmp_path):
+    q = WorkQueue(str(tmp_path)).setup()
+    tid = q.add_task(0, 4)
+    assert q.write_done(tid, {"tid": tid, "start": 0, "stop": 4,
+                              "status": "done", "owner": "w0-1"})
+    assert not q.write_done(tid, {"tid": tid, "start": 0, "stop": 4,
+                                  "status": "done", "owner": "w1-2"})
+    assert q.done()[tid]["owner"] == "w0-1"
+    assert not q.stop_requested()
+    q.request_stop()
+    assert q.stop_requested()
+
+
+# ---------------------------------------------------------------------
+# Satellite: atomic result payloads + the fsync knob.
+
+def test_atomic_save_results_roundtrip(tmp_path, monkeypatch):
+    from pycatkin_tpu.utils.io import atomic_save_results, load_results
+
+    arrays = {"y": np.linspace(0.0, 1.0, 7),
+              "success": np.ones(7, dtype=bool)}
+    for fsync_env in ("", "1"):
+        monkeypatch.setenv("PYCATKIN_JOURNAL_FSYNC", fsync_env)
+        path = str(tmp_path / f"res_{fsync_env or '0'}.npz")
+        atomic_save_results(path, arrays)
+        back = load_results(path)
+        for k in arrays:
+            np.testing.assert_array_equal(arrays[k], back[k])
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert leftovers == []
+
+
+# ---------------------------------------------------------------------
+# Satellite: fleet-wide fault budgets (`state_dir` ticket files) -- a
+# restarted worker re-reading the same times=1 plan must NOT re-fire.
+
+def test_fault_budget_is_fleet_wide(tmp_path):
+    plan_text = json.dumps({
+        "specs": [{"site": "s", "kind": "stall", "times": 1,
+                   "delay_s": 0.0}],
+        "state_dir": str(tmp_path / "faultstate")})
+    first = FaultPlan.from_env(plan_text)
+    first.on_call("s")
+    assert [e["kind"] for e in first.log] == ["stall"]
+    # A fresh plan from the same env text = a restarted incarnation.
+    second = FaultPlan.from_env(plan_text)
+    second.on_call("s")
+    assert second.log == []
+    # Without a state_dir the budget is per-process: both fire.
+    local_text = json.dumps([{"site": "s", "kind": "stall", "times": 1,
+                              "delay_s": 0.0}])
+    for plan in (FaultPlan.from_env(local_text),
+                 FaultPlan.from_env(local_text)):
+        plan.on_call("s")
+        assert len(plan.log) == 1
+
+
+# ---------------------------------------------------------------------
+# The chaos proof: two workers SIGKILLed mid-chunk, one heartbeat
+# stalled past the TTL -- the merged sweep must be bit-identical to the
+# undisturbed in-process sweep of the same chunk grid.
+
+N_LANES = 12
+CHUNK = 2
+
+
+def _drill_sim():
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel.batch import broadcast_conditions
+
+    sim = synthetic_system(n_species=8, n_reactions=10, seed=0)
+    conds = broadcast_conditions(sim.conditions(), N_LANES)
+    conds = conds._replace(T=np.linspace(450.0, 650.0, N_LANES))
+    return sim, conds
+
+
+@pytest.fixture(scope="module")
+def chaos_run(tmp_path_factory):
+    sim, conds = _drill_sim()
+    td = tmp_path_factory.mktemp("chaos")
+    plan = {"specs": [
+        {"site": "worker:0", "kind": "worker-crash", "times": 1},
+        {"site": "worker:1", "kind": "worker-crash", "times": 1},
+        {"site": "heartbeat:2", "kind": "heartbeat-stall", "times": 1,
+         "delay_s": 120.0}],
+        "state_dir": str(td / "faultstate")}
+    out, report = run_elastic(
+        sim, conds, n_workers=3, chunk=CHUNK,
+        work_dir=str(td / "work"),
+        worker_env={"PYCATKIN_FAULTS": json.dumps(plan),
+                    "JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": ""},
+        ttl_s=4.0, heartbeat_s=0.4, max_kills=5,
+        restart_base_s=0.2, restart_max_s=1.0, timeout=600.0)
+    return sim, conds, out, report
+
+
+def test_chaos_bit_identity(chaos_run):
+    from pycatkin_tpu.parallel.batch import sweep_steady_state
+
+    sim, conds, out, report = chaos_run
+    # The carnage happened: both scripted crashes landed (signal deaths
+    # NOT initiated by the supervisor) plus the stall-kill, and every
+    # death was supervised back to life.
+    crashes = [e for e in report["exits"]
+               if e["kind"] == "signal-death" and not e["self_killed"]]
+    stalled = [e for e in report["exits"] if e["self_killed"]]
+    assert len(crashes) >= 2
+    assert len(stalled) >= 1
+    assert report["restarts"] >= 3
+    assert report["leases"]["expired"] >= 1
+    assert report["quarantined"] == []
+    assert report["n_failed_lanes"] == 0
+
+    # Bit-identity against the undisturbed same-grid sweep: the
+    # deterministic per-chunk programs make duplicate/stolen work
+    # indistinguishable from first-try work.
+    ref_parts = []
+    for a in range(0, N_LANES, CHUNK):
+        sub = type(conds)(**{
+            f: np.asarray(getattr(conds, f))[a:a + CHUNK]
+            for f in conds._fields})
+        ref = sweep_steady_state(sim.spec, sub)
+        ref_parts.append({k: np.asarray(v) for k, v in ref.items()})
+    merged = {k: np.concatenate([p[k] for p in ref_parts], axis=0)
+              for k in ref_parts[0]}
+    assert set(out) == set(merged)
+    for k in merged:
+        np.testing.assert_array_equal(
+            out[k], merged[k],
+            err_msg=f"chaos run diverged from undisturbed sweep at {k!r}")
+
+
+def test_chaos_forensics_lifecycle(chaos_run):
+    from pycatkin_tpu.robustness.forensics import (format_failure_report,
+                                                   sweep_failure_report,
+                                                   worker_lifecycle)
+
+    _, conds, out, report = chaos_run
+    wl = worker_lifecycle(report["events"])
+    assert wl["n_restarts"] >= 3
+    assert wl["spawns"] >= 3
+    assert wl["killed_stalled"]
+    assert wl["leases_expired"]
+    assert wl["quarantined"] == []
+    full = sweep_failure_report(out, conds=conds,
+                                events=report["events"])
+    assert full["worker_lifecycle"]["n_restarts"] == wl["n_restarts"]
+    text = format_failure_report(full)
+    assert "worker lifecycle" in text
+    assert "restarted" in text
+
+
+# ---------------------------------------------------------------------
+# The poison proof: a span that kills every worker touching it is
+# bisected to the floor and quarantined; the rest of the sweep lands.
+
+@pytest.fixture(scope="module")
+def poison_run(tmp_path_factory):
+    sim, conds = _drill_sim()
+    td = tmp_path_factory.mktemp("poison")
+    # Unlimited crash on any task starting at lane 4: the id encodes
+    # the span, so the pattern follows the poison through bisection
+    # ([4,8) -> [4,6) -> [4,5)) while the split-off healthy halves
+    # ([6,8), [5,6)) escape it.
+    plan = [{"site": "lease:t00004_*", "kind": "worker-crash",
+             "times": None}]
+    work_dir = str(td / "work")
+    out, report = run_elastic(
+        sim, conds, n_workers=2, chunk=4, min_chunk=1, max_kills=1,
+        work_dir=work_dir,
+        worker_env={"PYCATKIN_FAULTS": json.dumps(plan),
+                    "JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": ""},
+        ttl_s=6.0, heartbeat_s=0.5,
+        restart_base_s=0.2, restart_max_s=1.0, timeout=600.0)
+    return out, report, work_dir
+
+
+def test_poison_bisected_to_floor_and_quarantined(poison_run):
+    out, report, _ = poison_run
+    assert set(report["bisected"]) == {"t00004_00008", "t00004_00006"}
+    assert report["quarantined"] == ["t00004_00005"]
+    assert report["restarts"] >= 3            # one per poisoned claim
+    success = np.asarray(out["success"], dtype=bool)
+    assert success.shape == (N_LANES,)
+    assert not success[4]                     # the one poisoned lane
+    assert success[np.arange(N_LANES) != 4].all()
+    quarantined = np.asarray(out["quarantined"], dtype=bool)
+    assert quarantined[4]
+    assert int(quarantined.sum()) == 1
+
+
+def test_obsview_workers_timeline(poison_run):
+    _, _, work_dir = poison_run
+    events_path = os.path.join(work_dir, "events.jsonl")
+    assert os.path.exists(events_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsview.py"),
+         "--workers", events_path],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "task-quarantined" in proc.stdout
+    assert "task-bisected" in proc.stdout
+    assert "restart" in proc.stdout

@@ -12,12 +12,18 @@ Usage::
 
     python tools/obsview.py RUN.trace.json [--top N]
     python tools/obsview.py --lanes SWEEP.json
+    python tools/obsview.py --workers WORKDIR/events.jsonl
     python tools/obsview.py --selftest [--sweep]
 
 ``--lanes`` renders the per-lane solver telemetry heatmap (iteration /
 chord / residual-decade / rescue-strategy, one glyph per lane) from any
 JSON file carrying a packed ``lane_telemetry`` array -- a bench record
 or a dumped sweep output.
+
+``--workers`` renders the elastic scheduler's lease/restart timeline
+from a work directory's ``events.jsonl`` (or any JSON file carrying an
+``events`` list): every spawn, crash, restart, expired/stolen lease,
+bisection and quarantine in chronological order.
 
 ``--selftest`` is the ``make obs-check`` CI lane: it round-trips a
 programmatic trace through the Chrome exporter, verifies parenting,
@@ -123,8 +129,31 @@ def selftest(sweep: bool = False) -> int:
         return _fail(f"lane heatmap glyphs wrong:\n{heat}")
     print(heat)
 
+    # 6. Worker lifecycle timeline on scripted scheduler events.
+    from pycatkin_tpu.obs import format_worker_timeline, worker_summary
+    wev = [
+        {"kind": "worker", "action": "spawn", "label": "worker:0",
+         "t": 100.0, "pid": 11, "incarnation": 0},
+        {"kind": "worker", "action": "exit", "label": "worker:0",
+         "t": 102.5, "returncode": -9, "exit_kind": "signal-death"},
+        {"kind": "worker", "action": "restart", "label": "worker:0",
+         "t": 102.5, "attempt": 1, "delay_s": 0.3},
+        {"kind": "worker", "action": "lease-stolen",
+         "label": "lease:t00000_00004", "t": 103.0, "owner": "w1-12",
+         "stolen_from": "w0-11"},
+        {"kind": "span", "label": "not-a-worker-event", "dur": 1.0},
+    ]
+    ws = worker_summary(wev)
+    if ws["n_events"] != 4 or ws["restarts"].get("worker:0") != 1:
+        return _fail(f"worker summary wrong: {ws}")
+    timeline = format_worker_timeline(wev)
+    if ("lease-stolen" not in timeline or "signal-death" not in timeline
+            or "2.500s" not in timeline):
+        return _fail(f"worker timeline rendering wrong:\n{timeline}")
+    print(timeline)
+
     if sweep:
-        # 6. A real (tiny, CPU-friendly) sweep under a run trace: the
+        # 7. A real (tiny, CPU-friendly) sweep under a run trace: the
         #    exported trace must reproduce the counted sync labels --
         #    on the fused clean path that is exactly one, the packed
         #    "fused tail bundle".
@@ -176,6 +205,28 @@ def _find_lane_telemetry(obj):
     return None
 
 
+def workers_view(path: str) -> int:
+    from pycatkin_tpu.obs import format_worker_timeline
+    try:
+        if path.endswith(".jsonl"):
+            from pycatkin_tpu.utils.io import read_json_lines
+            events = read_json_lines(path)
+        else:
+            with open(path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+            events = (obj.get("events", obj)
+                      if isinstance(obj, dict) else obj)
+    except (OSError, ValueError) as e:
+        return _fail(str(e))
+    if not isinstance(events, list):
+        return _fail(f"{path}: no event list found")
+    print(format_worker_timeline(events))
+    if not any(e.get("kind") == "worker" for e in events
+               if isinstance(e, dict)):
+        return _fail(f"{path}: no worker lifecycle events in the file")
+    return 0
+
+
 def lanes_view(path: str) -> int:
     from pycatkin_tpu.obs import format_lane_heatmap
     try:
@@ -204,6 +255,10 @@ def main(argv=None) -> int:
     ap.add_argument("--lanes", metavar="JSON",
                     help="render the per-lane telemetry heatmap from "
                          "a JSON file carrying 'lane_telemetry'")
+    ap.add_argument("--workers", metavar="EVENTS",
+                    help="render the elastic worker lease/restart "
+                         "timeline from an events.jsonl (or a JSON "
+                         "file with an 'events' list)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the obs-check self-test instead of "
                          "reading a trace")
@@ -216,6 +271,8 @@ def main(argv=None) -> int:
         return selftest(sweep=args.sweep)
     if args.lanes:
         return lanes_view(args.lanes)
+    if args.workers:
+        return workers_view(args.workers)
     if not args.trace:
         ap.error("need a trace file (or --lanes / --selftest)")
 
