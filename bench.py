@@ -490,8 +490,15 @@ def main():
             f"(sample of {BASELINE_SAMPLE})")
         vs_baseline = (sec_per_pt * n_points) / wall
 
+    from pycatkin_tpu import precision
     result = {
         "metric": metric,
+        # Executing backend + precision tier, top-level so the
+        # perfwatch history (obs/history.py) can segment baselines:
+        # CPU and TPU rounds -- or f64 and f32-polish rounds -- are
+        # different physical experiments.
+        "backend": dev.platform,
+        "tier": precision.active_tier(),
         "value": round(pts_per_s, 2),
         "unit": "points/s",
         "value_min": round(n_points / max(walls), 2),
@@ -562,7 +569,7 @@ def main():
         "cost_ledger": cost_ledger,
         "mfu": (cost_ledger.get("totals") or {}).get("mfu"),
         # Per-lane solver telemetry aggregates of the last timed trial
-        # (full [lanes, 4] arrays stay out of the JSON line at 256x256;
+        # (full [lanes, 5] arrays stay out of the JSON line at 256x256;
         # use --trace / tools/obsview.py --lanes for the heatmap).
         "lanes": lanes,
         # Self-describing record: git state, backend, mesh, every set
@@ -691,6 +698,40 @@ def smoke_main():
             abi_marginal_prewarm_s = time.perf_counter() - t0
             abi_marginal_compiled = int(n_b.compiled)
             abi_zero_compile_ok = n_b.compiled == 0
+
+        # Precision-tier gate (ISSUE-11): flipping the tier to
+        # f32-polish must converge the same sweep, reproduce the f64
+        # verdict masks bitwise, and stamp the telemetry tier column
+        # on every first-pass acceptance
+        # (docs/perf_precision_tiers.md). Runs inside the scratch AOT
+        # cache block: the tiered program is a fresh compile.
+        from pycatkin_tpu import precision
+        ambient_tier = precision.active_tier()
+        tier_prev = os.environ.get(precision.TIER_ENV)
+        tier_err = None
+        try:
+            os.environ[precision.TIER_ENV] = "f32-polish"
+            out32 = sweep_steady_state(spec, conds, tof_mask=mask,
+                                       check_stability=True)
+            for k in ("success", "stable", "quarantined"):
+                a, b = np.asarray(out[k]), np.asarray(out32[k])
+                if a.tobytes() != b.tobytes():
+                    tier_err = (f"verdict {k!r} differs between "
+                                f"{ambient_tier} and f32-polish")
+                    break
+            tel32 = np.asarray(out32["lane_telemetry"])
+            code32 = precision.TIER_CODES["f32-polish"]
+            if tier_err is None and not np.any(tel32[:, 4] == code32):
+                tier_err = ("no telemetry row carries the f32-polish "
+                            "tier code")
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            tier_err = str(e)
+        finally:
+            if tier_prev is None:
+                os.environ.pop(precision.TIER_ENV, None)
+            else:
+                os.environ[precision.TIER_ENV] = tier_prev
+        tier_ok = tier_err is None
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -766,7 +807,7 @@ def smoke_main():
     costs_ok = n_costed >= int(n_prog) and dispatched
 
     # Per-lane telemetry gate: the sweep output must carry the packed
-    # [lanes, 4] bundle (it rides inside the one counted sync) and the
+    # [lanes, 5] bundle (it rides inside the one counted sync) and the
     # per-lane histograms must have observed every lane.
     lane_tel = out.get("lane_telemetry")
     hists = obs_metrics.snapshot()["histograms"]
@@ -794,8 +835,13 @@ def smoke_main():
     if TRACE_DIR:
         with open(os.path.join(TRACE_DIR, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=2, sort_keys=True)
+    import jax as _jax
     result = {
         "metric": metric + " (smoke)",
+        "backend": _jax.devices()[0].platform,
+        "tier": ambient_tier,
+        "tier_ok": tier_ok,
+        "tier_error": tier_err,
         "n_points": n,
         "converged": n_ok,
         "prewarm_s": round(prewarm_s, 2),
@@ -867,6 +913,9 @@ def smoke_main():
         log(f"bench-smoke: FAIL -- second mechanism in the warm ABI "
             f"bucket compiled {abi_marginal_compiled} program(s) "
             f"(must be 0 under PYCATKIN_ABI=1)")
+        return 1
+    if not tier_ok:
+        log(f"bench-smoke: FAIL -- precision-tier gate: {tier_err}")
         return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import precision as _precision
 from .constants import (JtoeV, LOG_H_OVER_KB, R, bartoPa, eVtokJ, h, kB)
 from .frontend.spec import REACTOR_CSTR, REACTOR_ID, Conditions, ModelSpec
 from .ops import linalg, network, rates, thermo
@@ -221,11 +222,38 @@ def _dynamic_setup(spec: ModelSpec, cond: Conditions):
     return dyn, static, jnp.asarray(cond.y0)
 
 
-def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr):
+def _cast_float_leaves(tree: dict, dtype) -> dict:
+    """Copy of a kwargs dict with every floating-point array leaf cast
+    to ``dtype`` (index/bool/int leaves untouched) -- the one seam that
+    rebases a reactor closure onto the precision-tier bulk dtype."""
+    out = {}
+    for k, v in tree.items():
+        a = jnp.asarray(v)
+        out[k] = a.astype(dtype) if jnp.issubdtype(a.dtype,
+                                                   jnp.floating) else v
+    return out
+
+
+def _dynamic_fscale(spec: ModelSpec, cond: Conditions, kf, kr,
+                    dtype=None):
     """fscale(x) -> (F, gross) over the dynamic indices: the residual
     plus the per-species gross-flux scale, computed in one pass (the
-    solver's net-vs-gross convergence measure)."""
+    solver's net-vs-gross convergence measure).
+
+    ``dtype``: evaluation dtype of the closure (default: whatever the
+    operands carry, i.e. f64). The precision-tier bulk pass requests
+    ``precision.bulk_dtype(tier)``: the rate constants are ALWAYS
+    computed in f64 first (exp(-Ea/kT) spans ~30 decades -- evaluating
+    it in f32 overflows/underflows outright) and only the finished
+    kf/kr/y0/stoichiometry values are cast down here, so the f32
+    closure evaluates the same finished numbers at reduced precision.
+    """
     dyn, static, y_base = _dynamic_setup(spec, cond)
+    if dtype is not None:
+        static = _cast_float_leaves(static, dtype)
+        kf = jnp.asarray(kf, dtype)
+        kr = jnp.asarray(kr, dtype)
+        y_base = jnp.asarray(y_base, dtype)
     # ABI-padded specs carry a dynamic validity mask; pad slots get the
     # exactly-decoupled residual x' = -x, so the padded Jacobian is
     # blkdiag(J_real, -I): real solutions, verdicts and certificates
@@ -265,7 +293,7 @@ def steady_state(spec: ModelSpec, cond: Conditions,
                  x0=None, key=None,
                  opts: SolverOptions = SolverOptions(),
                  strategy: str = "ptc",
-                 use_x0=None) -> SteadyStateResults:
+                 use_x0=None, tier: str = "f64") -> SteadyStateResults:
     """Steady-state solve over the dynamic indices (adsorbates, plus gas
     for CSTR), gas clamped otherwise -- reference system.py:512-639 /
     old_system.py:385-434 semantics with on-device retry logic.
@@ -274,10 +302,22 @@ def steady_state(spec: ModelSpec, cond: Conditions,
     ``x0`` (True) and the default initial coverages (False) -- lets the
     consolidated rescue program keep seeded/unseeded variants inside
     ONE compiled program instead of two (x0=None is a different
-    treedef, hence a different program)."""
+    treedef, hence a different program).
+    ``tier``: precision tier (docs/perf_precision_tiers.md). Under
+    "f32-polish" a SECOND closure over the same finished rate constants
+    is built at the bulk dtype and the solver runs its march there,
+    polishing and verdicting in f64; only the static single-attempt
+    fast pass uses it (newton.solve_steady gates), so rescue solves
+    through this same entry point stay pure f64."""
     kf, kr, _ = rate_constants(spec, cond)
     fscale, dyn, y_base = _dynamic_fscale(spec, cond, kf, kr)
     jac = jax.jacfwd(lambda x: fscale(x)[0])
+    bulk_fns = None
+    if tier != "f64":
+        bulk_fscale, _, _ = _dynamic_fscale(
+            spec, cond, kf, kr, dtype=_precision.bulk_dtype(tier))
+        bulk_fns = (bulk_fscale,
+                    jax.jacfwd(lambda x: bulk_fscale(x)[0]))
     if x0 is None:
         x0 = y_base[dyn]
     elif use_x0 is not None:
@@ -286,7 +326,7 @@ def steady_state(spec: ModelSpec, cond: Conditions,
     (x, success, res, iters, attempts, rate_ok, pos_ok, sums_ok,
      dt_exit, chords) = newton.solve_steady(
         fscale, jac, jnp.asarray(x0), groups_dyn, opts, key=key,
-        strategy=strategy)
+        strategy=strategy, tier=tier, bulk_fns=bulk_fns)
     y_full = y_base.at[dyn].set(x)
     return SteadyStateResults(x=y_full, success=success, residual=res,
                               iterations=iters, attempts=attempts,

@@ -51,6 +51,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from .. import precision as _precision
 from .spec import REACTOR_CSTR, Conditions, ModelSpec
 
 ABI_VERSION = 1
@@ -78,19 +79,32 @@ _BOUNDARY_MARGIN = 0.05   # validate.py warns within 5% of a bucket edge
 
 
 class AbiStatic(NamedTuple):
-    """Everything a compiled ABI program may specialize on."""
+    """Everything a compiled ABI program may specialize on.
+
+    ``precision`` is the solver precision tier the bucket's programs
+    are built for (:mod:`pycatkin_tpu.precision`): an f32-bulk program
+    computes different math from the f64 one, so the tiers must intern
+    as DIFFERENT buckets and can never share an AOT entry. The traced
+    operand dtypes themselves stay f64 under every tier -- the f64
+    polish-and-verify stage needs full-precision mechanism data, and
+    the in-program downcast of the bulk stage is free -- so padding and
+    operand layout are tier-invariant."""
     abi_version: int
     n_species: int       # S (padded, includes the reserved pad slot)
     n_reactions: int     # R (padded)
     n_dynamic: int       # D (padded dynamic dim)
     reactor_type: int
     desorption_model: str
+    precision: str = "f64"
 
 
 def abi_fingerprint_of(static: AbiStatic) -> str:
-    return ("abi-v{0}:s{1}:r{2}:d{3}:rt{4}:{5}".format(
+    # The f64 tag is empty: every pre-tier fingerprint (and the AOT
+    # pack entries keyed on it) stays byte-identical.
+    return ("abi-v{0}:s{1}:r{2}:d{3}:rt{4}:{5}{6}".format(
         static.abi_version, static.n_species, static.n_reactions,
-        static.n_dynamic, static.reactor_type, static.desorption_model))
+        static.n_dynamic, static.reactor_type, static.desorption_model,
+        _precision.tier_tag(static.precision)))
 
 
 def abi_enabled() -> bool:
@@ -174,7 +188,8 @@ def select_static(spec: ModelSpec, species_bucket: int | None = None,
             D *= 2
     return AbiStatic(abi_version=ABI_VERSION, n_species=S, n_reactions=R,
                      n_dynamic=D, reactor_type=int(spec.reactor_type),
-                     desorption_model=str(spec.desorption_model))
+                     desorption_model=str(spec.desorption_model),
+                     precision=_precision.active_tier())
 
 
 def _deflated_dim(spec: ModelSpec) -> int:
@@ -484,11 +499,14 @@ _FALLBACK_WARNED: set = set()
 
 def lower_spec(spec: ModelSpec, species_bucket: int | None = None,
                reaction_bucket: int | None = None) -> AbiLowered:
-    """Lower ``spec`` into its ABI bucket (cached per spec identity for
-    the default-bucket case; forced buckets are not cached)."""
+    """Lower ``spec`` into its ABI bucket (cached per (spec identity,
+    precision tier) for the default-bucket case -- flipping the tier
+    env var must re-intern into the tier's own bucket, never reuse a
+    stale lowering; forced buckets are not cached)."""
+    cache_key = (spec, _precision.active_tier())
     if species_bucket is None and reaction_bucket is None:
         with _LOWER_LOCK:
-            low = _LOWER_CACHE.get(spec)
+            low = _LOWER_CACHE.get(cache_key)
         if low is not None:
             return low
     st = select_static(spec, species_bucket, reaction_bucket)
@@ -504,7 +522,7 @@ def lower_spec(spec: ModelSpec, species_bucket: int | None = None,
             warnings.warn(f"mechanism ABI: {issue}", UserWarning,
                           stacklevel=3)
         with _LOWER_LOCK:
-            _LOWER_CACHE[spec] = low
+            _LOWER_CACHE[cache_key] = low
     return low
 
 

@@ -1,4 +1,4 @@
-"""PCL005 dtype-discipline: no hardcoded float64 in the numerical
+"""PCL005 dtype-discipline: no hardcoded float dtypes in the numerical
 kernels (``ops/``, ``solvers/``).
 
 The x64 policy is process-global and owned by the package root
@@ -12,7 +12,16 @@ diverges from every other kernel, and stiff chemical ODE solves fail
 in the worst way -- plausible-looking wrong numbers. Inherit dtypes
 from the inputs, or derive them from the policy in one place.
 
-Host-side interop that genuinely needs a concrete f64 (e.g. handing
+The same discipline covers the other direction: a raw ``jnp.float32``
+/ ``astype("float32")`` downcast bypasses the precision-tier layer
+(``pycatkin_tpu/precision.py`` -- the ONE blessed entry point, keyed by
+``PYCATKIN_PRECISION_TIER``). An ad-hoc f32 cast runs reduced-precision
+math that the tier's f64 polish-and-verify acceptance contract never
+checks, so verdicts can silently degrade. Route every downcast through
+``precision.bulk_dtype`` / ``precision.cast_bulk`` (the precision
+module itself is the policy seam and is outside this rule's scope).
+
+Host-side interop that genuinely needs a concrete dtype (e.g. handing
 numpy a deterministic scratch array) suppresses inline with a reason
 or lives in the committed baseline.
 """
@@ -24,16 +33,18 @@ from typing import Iterable
 
 from .core import Checker, Finding, SourceFile, register
 
-_F64_BASES = frozenset({"np", "numpy", "jnp"})
+_FLOAT_BASES = frozenset({"np", "numpy", "jnp"})
 
 
 @register
 class DtypeChecker(Checker):
     rule = "PCL005"
     name = "dtype-discipline"
-    description = ("hardcoded float64 in a numerical kernel; inherit "
-                   "the dtype or route it through the x64 policy "
-                   "(constants.py / PYCATKIN_TPU_X64)")
+    description = ("hardcoded float dtype in a numerical kernel; "
+                   "inherit the dtype, route f64 through the x64 "
+                   "policy (constants.py / PYCATKIN_TPU_X64) and f32 "
+                   "through the precision-tier helper "
+                   "(pycatkin_tpu.precision)")
     scope = ("pycatkin_tpu/ops/", "pycatkin_tpu/solvers/")
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
@@ -41,12 +52,22 @@ class DtypeChecker(Checker):
             if (isinstance(node, ast.Attribute)
                     and node.attr == "float64"
                     and isinstance(node.value, ast.Name)
-                    and node.value.id in _F64_BASES):
+                    and node.value.id in _FLOAT_BASES):
                 yield self.finding(
                     src, node,
                     f"hardcoded {node.value.id}.float64 in a "
                     f"numerical kernel; inherit the dtype from the "
                     f"inputs or derive it from the x64 policy")
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "float32"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in _FLOAT_BASES):
+                yield self.finding(
+                    src, node,
+                    f"raw {node.value.id}.float32 downcast in a "
+                    f"numerical kernel bypasses the precision-tier "
+                    f"layer; use pycatkin_tpu.precision.bulk_dtype / "
+                    f"cast_bulk (the one blessed entry point)")
             elif (isinstance(node, ast.Constant)
                     and node.value == "float64"):
                 yield self.finding(
@@ -54,3 +75,11 @@ class DtypeChecker(Checker):
                     "bare \"float64\" dtype literal in a numerical "
                     "kernel; inherit the dtype from the inputs or "
                     "derive it from the x64 policy")
+            elif (isinstance(node, ast.Constant)
+                    and node.value == "float32"):
+                yield self.finding(
+                    src, node,
+                    "bare \"float32\" dtype literal in a numerical "
+                    "kernel bypasses the precision-tier layer; use "
+                    "pycatkin_tpu.precision.bulk_dtype / cast_bulk "
+                    "(the one blessed entry point)")

@@ -40,6 +40,8 @@ from __future__ import annotations
 import math
 import threading
 
+from .. import precision as _precision
+
 # Measured device ceilings (flop/s, bytes/s) for the MFU denominator,
 # keyed by a lowercase substring of ``jax.devices()[0].device_kind``.
 # The TPU v5e numbers are the microbenchmarked rooflines from
@@ -49,16 +51,28 @@ import threading
 # 1.97e14 bf16 marketing peak; the byte ceiling is the measured HBM
 # stream rate. Unknown device kinds (CPU included) get no peak and an
 # MFU of None -- a fabricated denominator is worse than no MFU.
+#
+# ``flops_per_s_f32`` is the NATIVE-f32 compute ceiling used for
+# precision-tiered programs (kind tagged ``:p32``): an f32-bulk solve
+# scored against the f64-emulation roofline would report a flattering
+# >100% MFU. PROVISIONAL value: the measured f64-emulation roofline
+# scaled by the ~16x double-float FMA expansion (docs/perf_mfu.md);
+# replace with a microbenchmarked number the first time the tiered
+# bench runs on hardware (docs/perf_precision_tiers.md tracks this).
 DEVICE_PEAKS = {
-    "v5 lite": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
-    "v5e": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
-    "v5p": {"flops_per_s": 1.519e11, "bytes_per_s": 3.228e11},
+    "v5 lite": {"flops_per_s": 1.519e11, "flops_per_s_f32": 2.430e12,
+                "bytes_per_s": 3.228e11},
+    "v5e": {"flops_per_s": 1.519e11, "flops_per_s_f32": 2.430e12,
+            "bytes_per_s": 3.228e11},
+    "v5p": {"flops_per_s": 1.519e11, "flops_per_s_f32": 2.430e12,
+            "bytes_per_s": 3.228e11},
 }
 
 
 def device_peak(device_kind) -> dict | None:
-    """The measured ``{"flops_per_s", "bytes_per_s"}`` ceiling for a
-    device kind, or None when no honest ceiling is known."""
+    """The measured ``{"flops_per_s", "flops_per_s_f32", "bytes_per_s"}``
+    ceiling for a device kind, or None when no honest ceiling is
+    known."""
     if not device_kind:
         return None
     kind = str(device_kind).lower()
@@ -66,6 +80,19 @@ def device_peak(device_kind) -> dict | None:
         if key in kind:
             return dict(peak)
     return None
+
+
+def peak_flops_for_tier(peak: dict | None, tier: str) -> float | None:
+    """The compute ceiling a program of precision ``tier`` is honestly
+    scored against: the native-f32 roofline for the f32-bulk tier
+    (falling back to the f64 ceiling when no f32 number is recorded --
+    an underestimated denominator only ever deflates MFU), the
+    f64-emulation roofline otherwise."""
+    if not peak:
+        return None
+    if tier == "f32-polish":
+        return peak.get("flops_per_s_f32") or peak.get("flops_per_s")
+    return peak.get("flops_per_s")
 
 
 def flops_per_iteration(n_s: int, n_r: int, n_dyn: int,
@@ -199,12 +226,26 @@ class CostLedger:
         ``achieved_bytes_per_s`` / ``hbm_util``) wherever a row has
         both a harvested cost and a nonzero blocked wall. MFU is
         against :func:`device_peak`; None when no ceiling is known
-        (CPU) -- absent, not fabricated."""
+        (CPU) -- absent, not fabricated.
+
+        Precision-tiered rows are scored against their OWN roofline:
+        each row carries a ``tier`` (parsed from the ``:p32`` tag in
+        its program kind, see :func:`pycatkin_tpu.precision.tier_of_tag`)
+        and its mfu denominator is :func:`peak_flops_for_tier`. The
+        aggregate ``totals["mfu"]`` divides total flops by the
+        tier-weighted peak budget (sum of each row's own ceiling times
+        its blocked wall -- identical to the historical formula when
+        every program is f64), and ``totals["mfu_by_tier"]`` breaks the
+        same ratio out per tier."""
         peak = device_peak(device_kind)
         with self._lock:
             rows = {k: dict(v) for k, v in self._rows.items()}
-        tot_flops = tot_wall = 0.0
+        tot_flops = tot_wall = tot_peak_budget = 0.0
+        by_tier: dict = {}
         for row in rows.values():
+            tier = _precision.tier_of_tag(str(row.get("kind", "")))
+            row["tier"] = tier
+            peak_f = peak_flops_for_tier(peak, tier)
             wall = row.get("blocked_wall_s", 0.0)
             n = row.get("dispatches", 0)
             flops = row.get("flops")
@@ -214,9 +255,16 @@ class CostLedger:
                     row["achieved_flops_per_s"] = flops * n / wall
                     tot_flops += flops * n
                     tot_wall += wall
-                    if peak:
+                    t = by_tier.setdefault(tier,
+                                           {"flops": 0.0, "wall": 0.0,
+                                            "peak_budget": 0.0})
+                    t["flops"] += flops * n
+                    t["wall"] += wall
+                    if peak_f:
                         row["mfu"] = (row["achieved_flops_per_s"]
-                                      / peak["flops_per_s"])
+                                      / peak_f)
+                        tot_peak_budget += peak_f * wall
+                        t["peak_budget"] += peak_f * wall
                 if by is not None:
                     row["achieved_bytes_per_s"] = by * n / wall
                     if peak:
@@ -230,9 +278,13 @@ class CostLedger:
                       for r in rows.values()), 6)}
         if tot_wall > 0:
             totals["achieved_flops_per_s"] = tot_flops / tot_wall
-            if peak:
-                totals["mfu"] = (tot_flops / tot_wall
-                                 / peak["flops_per_s"])
+            if tot_peak_budget > 0:
+                totals["mfu"] = tot_flops / tot_peak_budget
+            mbt = {t: v["flops"] / v["peak_budget"]
+                   for t, v in sorted(by_tier.items())
+                   if v["peak_budget"] > 0}
+            if mbt:
+                totals["mfu_by_tier"] = mbt
         return {"programs": rows, "totals": totals, "peak": peak}
 
     def reset(self):

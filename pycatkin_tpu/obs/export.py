@@ -238,27 +238,28 @@ def attribute_outlier(trial_spans: list, walls: list,
     return out
 
 
-# -- per-lane solver telemetry (packed [lanes, 4] int rows) -----------
+# -- per-lane solver telemetry (packed [lanes, 5] int rows) -----------
 
 # Mirrors solvers.newton.STRATEGY_CODES -- duplicated here because this
 # module must stay importable without JAX (lint/CI tooling); the lane
-# telemetry test asserts the two stay in sync.
+# telemetry test asserts the two stay in sync. Tier names come straight
+# from pycatkin_tpu.precision (itself JAX-free at import).
 STRATEGY_NAMES = ("clean", "polish", "ptc", "lm", "unseeded", "demote",
                   "quarantine")
 _STRATEGY_GLYPHS = ".Ptlud#"    # one glyph per code; '#' = quarantine
 
 
 def _lane_rows(lane_telemetry) -> list:
-    """Normalize a packed ``[lanes, 4]`` telemetry array (numpy array
+    """Normalize a packed ``[lanes, 5]`` telemetry array (numpy array
     or nested lists: iterations, chords, residual decade, strategy
-    code) into plain int tuples."""
+    code, precision-tier code) into plain int tuples."""
     rows = []
     for row in lane_telemetry:
         vals = [int(v) for v in row]
-        if len(vals) != 4:
+        if len(vals) != 5:
             raise ValueError(
-                f"lane telemetry row has {len(vals)} fields, expected 4 "
-                f"(iterations, chords, residual_decade, strategy)")
+                f"lane telemetry row has {len(vals)} fields, expected 5 "
+                f"(iterations, chords, residual_decade, strategy, tier)")
         rows.append(tuple(vals))
     return rows
 
@@ -266,8 +267,10 @@ def _lane_rows(lane_telemetry) -> list:
 def lane_summary(lane_telemetry) -> dict:
     """Aggregate one sweep's packed per-lane telemetry into JSON:
     iteration/chord totals and extrema, the residual-decade histogram,
-    and per-strategy lane counts (``strategies`` maps name -> count,
-    zero-count strategies omitted)."""
+    per-strategy lane counts (``strategies`` maps name -> count,
+    zero-count strategies omitted) and per-precision-tier counts
+    (``tiers``: which tier produced each accepted iterate)."""
+    from .. import precision as _precision
     rows = _lane_rows(lane_telemetry)
     if not rows:
         return {"lanes": 0}
@@ -275,11 +278,16 @@ def lane_summary(lane_telemetry) -> dict:
     chs = [r[1] for r in rows]
     decades: dict = {}
     strategies: dict = {}
-    for _, _, dec, strat in rows:
+    tiers: dict = {}
+    tier_names = _precision.TIER_NAMES
+    for _, _, dec, strat, tier in rows:
         decades[dec] = decades.get(dec, 0) + 1
         name = (STRATEGY_NAMES[strat] if 0 <= strat < len(STRATEGY_NAMES)
                 else f"code{strat}")
         strategies[name] = strategies.get(name, 0) + 1
+        tname = (tier_names[tier] if 0 <= tier < len(tier_names)
+                 else f"code{tier}")
+        tiers[tname] = tiers.get(tname, 0) + 1
     return {
         "lanes": len(rows),
         "iterations": {"min": its[0], "median": its[len(its) // 2],
@@ -289,6 +297,7 @@ def lane_summary(lane_telemetry) -> dict:
         "residual_decades": {str(k): decades[k]
                              for k in sorted(decades)},
         "strategies": strategies,
+        "tiers": tiers,
     }
 
 
@@ -322,6 +331,10 @@ def format_lane_heatmap(lane_telemetry, width: int = 64) -> str:
         lines.append("  strategies  "
                      + "  ".join(f"{k}:{v}" for k, v
                                  in s["strategies"].items()))
+        if s.get("tiers"):
+            lines.append("  accepted-iterate tiers  "
+                         + "  ".join(f"{k}:{v}" for k, v
+                                     in s["tiers"].items()))
     return "\n".join(lines)
 
 

@@ -41,6 +41,19 @@ DEFAULT_REL_FLOOR = 0.10
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
+# Records that do not name their backend predate the field; every
+# checked-in round before it was a TPU v5e run, so that is the
+# historical default.
+DEFAULT_BACKEND = "tpu"
+
+
+def record_backend(record: dict) -> str:
+    """The executing backend a bench record was measured on. CPU and
+    TPU rounds are different physical experiments (throughput differs
+    by orders of magnitude), so baselines must never mix them."""
+    rec = _unwrap(record)
+    return str(rec.get("backend") or DEFAULT_BACKEND).lower()
+
 
 def _unwrap(record: dict) -> dict:
     """A BENCH_r*.json as checked in wraps the bench's JSON line under
@@ -86,7 +99,8 @@ def load_history(root: str, pattern: str = "BENCH_r*.json") -> list:
             continue
         rec = _unwrap(record)
         out.append({"round": int(m.group(1)), "path": path,
-                    "record": rec, "metrics": extract_metrics(rec)})
+                    "record": rec, "metrics": extract_metrics(rec),
+                    "backend": record_backend(rec)})
     out.sort(key=lambda e: e["round"])
     return out
 
@@ -118,12 +132,20 @@ def flag_regressions(history: list, candidate: dict,
     entries carrying ``"metrics"``).
 
     A metric is flagged only when (a) the history holds at least
-    ``min_history`` samples of it, and (b) the candidate sits beyond
+    ``min_history`` SAME-BACKEND samples of it (a CPU smoke round
+    compared against TPU throughput history would flag a 100x
+    "regression" that is really a hardware change -- see
+    :func:`record_backend`), and (b) the candidate sits beyond
     ``max(mad_k * MAD, rel_floor * |median|)`` of the median in the bad
     direction. Each finding carries the baseline and the attribution of
     :func:`attribute_regression`.
     """
     cand = extract_metrics(candidate)
+    cand_backend = record_backend(candidate)
+    history = [e for e in history
+               if e.get("backend",
+                        record_backend(e.get("record") or {}))
+               == cand_backend]
     findings = []
     for metric, value in sorted(cand.items()):
         series = [e["metrics"][metric] for e in history

@@ -338,7 +338,7 @@ def make_mixed_solve(A: jnp.ndarray):
     row_max = jnp.max(jnp.abs(A), axis=-1, keepdims=True)
     r = jnp.where(row_max > 0, 1.0 / row_max, 1.0)
     As = A * r                                   # equilibrated, f64
-    LU32, perm = lu_factor(As.astype(jnp.float32))
+    LU32, perm = lu_factor(As.astype(jnp.float32))  # pclint: disable=PCL005 -- f32 is intrinsic to this mixed-precision refinement algorithm, not a tier choice
 
     def solve_fn(b):
         # b: [n] or [n, k] (the module's RHS convention); the row scale
@@ -353,9 +353,9 @@ def make_mixed_solve(A: jnp.ndarray):
         bmax = jnp.max(jnp.abs(bs), axis=0)
         bscale = jnp.where((bmax > 0) & jnp.isfinite(bmax), bmax, 1.0)
         bn = bs / bscale
-        x = lu_solve(LU32, perm, bn.astype(jnp.float32)).astype(dtype)
+        x = lu_solve(LU32, perm, bn.astype(jnp.float32)).astype(dtype)  # pclint: disable=PCL005 -- f32 is intrinsic to this mixed-precision refinement algorithm, not a tier choice
         res = bn - As @ x                        # f64 residual
-        dx = lu_solve(LU32, perm, res.astype(jnp.float32)).astype(dtype)
+        dx = lu_solve(LU32, perm, res.astype(jnp.float32)).astype(dtype)  # pclint: disable=PCL005 -- f32 is intrinsic to this mixed-precision refinement algorithm, not a tier choice
         return (x + dx) * bscale
 
     return solve_fn

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import engine
+from .. import precision as _precision
 from ..frontend import abi as _abi
 from ..frontend.spec import Conditions, ModelSpec
 from ..obs import costs as _costs
@@ -111,11 +112,14 @@ def _sharding_tag(sharding) -> str:
 
 
 def _steady_kind(opts: SolverOptions, strategy: str,
-                 sharding=None) -> str:
+                 sharding=None, tier: str = "f64") -> str:
     """Registry/cache kind string for a steady-solve program variant;
     prewarm and the hot path MUST derive it identically (shapes ride in
-    the key separately)."""
-    return f"steady:{strategy}:{opts!r}{_sharding_tag(sharding)}"
+    the key separately). ``tier`` tags non-default precision tiers so
+    f32-bulk and f64 programs never share a registry/AOT entry; the
+    f64 tag is empty, keeping every pre-tier key byte-identical."""
+    return (f"steady:{strategy}:{opts!r}{_precision.tier_tag(tier)}"
+            f"{_sharding_tag(sharding)}")
 
 
 def _pacing_key(opts: SolverOptions) -> SolverOptions:
@@ -139,15 +143,17 @@ def _screen_kind(pos_tol: float, backend: str) -> str:
 
 def _fused_kind(opts: SolverOptions, pos_tol: float, backend: str,
                 has_tof: bool, check_stability: bool,
-                sharding=None) -> str:
+                sharding=None, tier: str = "f64") -> str:
     """Registry/cache kind string for the fused sweep program (solve +
     quarantine + tier-0 certificate + TOF/activity + packed diagnostics
     in ONE dispatch). prewarm, warm_from_aot_cache and the hot path
     MUST derive it identically; ``opts`` must be the fast-pass options
-    (:func:`_fast_pass_opts`)."""
+    (:func:`_fast_pass_opts`). ``tier`` tags the precision tier the
+    bulk solve runs in (empty for f64: pre-tier keys stay
+    byte-identical; the cost ledger keys its roofline on this tag)."""
     return (f"fused:{opts!r}:{pos_tol!r}:{backend}"
             f":s{int(check_stability)}t{int(has_tof)}"
-            f"{_sharding_tag(sharding)}")
+            f"{_precision.tier_tag(tier)}{_sharding_tag(sharding)}")
 
 
 def _fused_enabled() -> bool:
@@ -244,7 +250,12 @@ def _donate_argnums(argnums):
 
 @lru_cache(maxsize=16)
 def _steady_program(spec: ModelSpec, opts: SolverOptions,
-                    out_sharding=None, strategy: str = "ptc"):
+                    out_sharding=None, strategy: str = "ptc",
+                    tier: str = "f64"):
+    # ``tier`` is an explicit cache-key parameter (never read from the
+    # environment inside the builder): flipping PYCATKIN_PRECISION_TIER
+    # at runtime must select a DIFFERENT cached program, not mutate a
+    # stale one.
     if isinstance(spec, _abi.AbiProgramSpec):
         # ABI form: the mechanism rides in as the leading traced operand
         # pytree instead of being constant-folded, so every mechanism in
@@ -255,7 +266,8 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
 
             def solve_one(cond, key, x0):
                 return engine.steady_state(tspec, cond, x0=x0, key=key,
-                                           opts=opts, strategy=strategy)
+                                           opts=opts, strategy=strategy,
+                                           tier=tier)
             return jax.vmap(solve_one)(conds, keys, x0)
         kw = {"donate_argnums": _donate_argnums((2,))}
         if out_sharding is not None:
@@ -264,7 +276,7 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
 
     def solve_one(cond, key, x0):
         return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
-                                   strategy=strategy)
+                                   strategy=strategy, tier=tier)
     fn = jax.vmap(solve_one)
     # Only the PRNG keys are donated: x0 may be caller-owned (sweep
     # seeds, continuation stage solutions) and conds are reused by
@@ -469,6 +481,12 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
         return out._replace(x=low.unpad_y(jnp.asarray(out.x)))
 
     n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    # Precision tier resolved at CALL time (like _resolve_backend) and
+    # passed as an explicit cache-key parameter, never read inside a
+    # cached builder. The f32-bulk pipeline only engages for
+    # single-attempt pacing (the fast pass); other opts run f64 math
+    # under a tier-tagged key.
+    tier = _precision.active_tier()
 
     # Retry covers BOTH failure windows: the dispatch (this is the
     # LARGEST lazy compile of the sweep surface, so a dropped
@@ -480,8 +498,8 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     # rebuilt inside the retried closures: the solve program donates
     # its key buffer, so a retry must never re-feed a consumed array.
     if mesh is None:
-        prog = _steady_program(_prog_spec(spec), opts)
-        kind = _steady_kind(opts, "ptc")
+        prog = _steady_program(_prog_spec(spec), opts, tier=tier)
+        kind = _steady_kind(opts, "ptc", tier=tier)
 
         def run_solve():
             keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
@@ -503,14 +521,15 @@ def batch_steady_state(spec: ModelSpec, conds: Conditions,
     conds_p = jax.device_put(conds_p, sharding)
     if x0_p is not None:
         x0_p = jax.device_put(x0_p, sharding)
-    prog_sh = _steady_program(_prog_spec(spec), opts, sharding)
+    prog_sh = _steady_program(_prog_spec(spec), opts, sharding,
+                              tier=tier)
     # The mesh path consults the registry like every other dispatch:
     # program keys carry the per-argument sharding fingerprint
     # (compile_pool._shape_signature), so a serialized executable is
     # only matched by calls with the very mesh layout it baked in --
     # prewarm(mesh=...) publishes those, and single-device entries can
     # never be confused for them.
-    kind_sh = _steady_kind(opts, "ptc", sharding)
+    kind_sh = _steady_kind(opts, "ptc", sharding, tier=tier)
 
     def run_solve_sharded():
         keys = jax.random.split(jax.random.PRNGKey(0), n_lanes)
@@ -722,7 +741,8 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float,
 @lru_cache(maxsize=16)
 def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                          pos_tol: float, backend: str, has_tof: bool,
-                         check_stability: bool, out_sharding=None):
+                         check_stability: bool, out_sharding=None,
+                         tier: str = "f64"):
     """The whole clean sweep as ONE device program: batched steady
     solve, per-lane NaN quarantine, tier-0 stability certificate
     (Gershgorin + deflated-Lyapunov -- byte-identical math to
@@ -745,7 +765,17 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
     ``opts`` must be the fast-pass options and ``backend`` the
     resolved executing platform (see :func:`_stability_screen_program`
     on why backend is a cache key). Only the PRNG keys are donated
-    (conds/x0 are caller-owned)."""
+    (conds/x0 are caller-owned).
+
+    ``tier`` (explicit cache key, mirroring :func:`_steady_program`)
+    selects the precision tier the bulk Newton/PTC march runs in
+    (engine.steady_state's f32-bulk + f64-polish pipeline under
+    ``f32-polish``); the quarantine demotion, the tier-0 stability
+    certificate and every verdict threshold below stay f64 REGARDLESS
+    of tier -- that is the acceptance contract
+    (docs/perf_precision_tiers.md). The lane-telemetry pack's 5th
+    column records the tier that produced each accepted iterate."""
+    tier_code = _precision.TIER_CODES[tier]
     from ..solvers.newton import (LYAPUNOV_MAX_DIM,
                                   deflation_basis_for_spec,
                                   effective_unit_roundoff,
@@ -771,7 +801,8 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
 
             def solve_one(cond, key, x0):
                 return engine.steady_state(tspec, cond, x0=x0, key=key,
-                                           opts=opts, strategy="ptc")
+                                           opts=opts, strategy="ptc",
+                                           tier=tier)
 
             res = jax.vmap(solve_one)(conds, keys, x0)
             finite_l = lane_finite_mask(res.x, res.residual)
@@ -823,18 +854,22 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                 n_neg = jnp.sum(lane_ok & (tofs < 0.0))
                 outs += [tofs, act, neg]
             # Packed per-lane telemetry (iterations/chords/residual
-            # decade/strategy) rides as the second-to-last output, so
-            # the clean tail syncs it in the SAME batched device_get
-            # as the diagnostics bundle -- sync count unchanged.
-            outs.append(packed_lane_telemetry(res.iterations, res.chords,
-                                              res.residual))
+            # decade/strategy/tier) rides as the second-to-last output,
+            # so the clean tail syncs it in the SAME batched device_get
+            # as the diagnostics bundle -- sync count unchanged. The
+            # tier column stamps lanes the first pass ACCEPTED (the
+            # rescue ladder that rewrites the rest is always f64).
+            outs.append(packed_lane_telemetry(
+                res.iterations, res.chords, res.residual,
+                tier=jnp.where(succ0, jnp.int32(tier_code),
+                               jnp.int32(0))))
             outs.append(packed_sweep_diagnostics(succ0, quar, amb,
                                                  demoted, n_neg))
             return tuple(outs)
 
         kw = {"donate_argnums": _donate_argnums((2,))}
         if out_sharding is not None:
-            # 3 = res + quar + the [lanes, 4] telemetry pack.
+            # 3 = res + quar + the [lanes, 5] telemetry pack.
             n_lane_outs = 3 + (2 if check_stability else 0) \
                 + (3 if has_tof else 0)
             repl = NamedSharding(out_sharding.mesh, P())
@@ -845,7 +880,7 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
 
     def solve_one(cond, key, x0):
         return engine.steady_state(spec, cond, x0=x0, key=key, opts=opts,
-                                   strategy="ptc")
+                                   strategy="ptc", tier=tier)
 
     if check_stability:
         eps_eff = effective_unit_roundoff(jnp.float64, backend)
@@ -907,8 +942,9 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
             outs += [tofs, act, neg]
         # Same second-to-last telemetry slot as the ABI branch (the
         # clean tail's single batched sync depends on the ordering).
-        outs.append(packed_lane_telemetry(res.iterations, res.chords,
-                                          res.residual))
+        outs.append(packed_lane_telemetry(
+            res.iterations, res.chords, res.residual,
+            tier=jnp.where(succ0, jnp.int32(tier_code), jnp.int32(0))))
         outs.append(packed_sweep_diagnostics(succ0, quar, amb, demoted,
                                              n_neg))
         return tuple(outs)
@@ -918,7 +954,7 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
         # out_shardings is a pytree PREFIX over the output tuple: one
         # sharding per top-level element (the SteadyStateResults
         # subtree takes the lane sharding wholesale; the scalar bundle
-        # is replicated). 3 = res + quar + the [lanes, 4] telemetry.
+        # is replicated). 3 = res + quar + the [lanes, 5] telemetry.
         n_lane_outs = 3 + (2 if check_stability else 0) \
             + (3 if has_tof else 0)
         repl = NamedSharding(out_sharding.mesh, P())
@@ -1403,7 +1439,7 @@ def _sweep_steady_state_tail(spec, conds, tof_mask, x0, opts, mesh,
     return _finish_sweep(spec, conds, res, opts, tof_mask,
                          check_stability, pos_jac_tol,
                          backend=_resolve_backend(mesh=mesh),
-                         mesh=tail_mesh)
+                         mesh=tail_mesh, tier=_precision.active_tier())
 
 
 def _assemble_clean(res, quar, stable, tofs, act,
@@ -1413,7 +1449,7 @@ def _assemble_clean(res, quar, stable, tofs, act,
     materialization happens here (the caller already has every count it
     needs). Mirrors _finish_sweep's clean-branch assembly exactly so
     the fused path's output is field-for-field identical.
-    ``lane_tel``: the already-materialized [lanes, 4] packed telemetry
+    ``lane_tel``: the already-materialized [lanes, 5] packed telemetry
     that rode the bundle sync."""
     out = {"y": res.x, "success": res.success,
            "residual": res.residual, "iterations": res.iterations,
@@ -1462,13 +1498,15 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     """
     n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
     backend = _resolve_backend(mesh=mesh)
+    tier = _precision.active_tier()
     fast = _fast_pass_opts(opts)
     has_tof = tof_mask is not None
     sh = _subset_sharding(mesh, n_lanes)
     prog = _fused_sweep_program(_prog_spec(spec), fast, pos_jac_tol,
-                                backend, has_tof, check_stability, sh)
+                                backend, has_tof, check_stability, sh,
+                                tier=tier)
     kind = _fused_kind(fast, pos_jac_tol, backend, has_tof,
-                       check_stability, sh)
+                       check_stability, sh, tier=tier)
     mask_arr = jnp.asarray(tof_mask) if has_tof else None
     tail = (mask_arr,) if has_tof else ()
 
@@ -1566,7 +1604,7 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
                            | jnp.asarray(quar))
     return _finish_sweep(spec, conds, res_raw, opts, tof_mask,
                          check_stability, pos_jac_tol, backend=backend,
-                         mesh=mesh)
+                         mesh=mesh, tier=tier)
 
 
 def _quarantine_mask(res, quarantined=None):
@@ -1598,10 +1636,12 @@ def _tail_bundle(success, quarantined, ambiguous, demoted, n_neg):
 # Device-side lane-telemetry pack for the LEGACY split tail (the fused
 # program computes its own copy in-program); rides the "sweep tail
 # bundle" sync so the legacy clean path's sync count does not grow.
+# ``tier`` is the per-lane tier column (int32 [lanes] or scalar 0).
 @jax.jit
-def _lane_telemetry_bundle(iterations, chords, residual):
+def _lane_telemetry_bundle(iterations, chords, residual, tier):
     from ..solvers.newton import packed_lane_telemetry
-    return packed_lane_telemetry(iterations, chords, residual)
+    return packed_lane_telemetry(iterations, chords, residual,
+                                 tier=tier)
 
 
 # Histogram buckets for the lane telemetry feed: iteration/chord counts
@@ -1614,7 +1654,7 @@ _LANE_DECADE_BUCKETS = (-16.0, -14.0, -12.0, -10.0, -8.0, -6.0, -4.0,
 
 
 def _note_lane_telemetry(tel, spec):
-    """Feed one sweep's materialized [lanes, 4] telemetry pack into the
+    """Feed one sweep's materialized [lanes, 5] telemetry pack into the
     per-lane histograms, labeled by the ABI bucket the sweep ran in
     (``unbucketed`` for legacy per-mechanism programs). Bulk
     ``observe_many`` -- one lock acquisition per column, not per lane."""
@@ -1639,11 +1679,15 @@ def _note_lane_telemetry(tel, spec):
             tel[:, 2], abi_bucket=bucket)
 
 
-def _host_lane_telemetry(res, quar, strategy_codes):
+def _host_lane_telemetry(res, quar, strategy_codes,
+                         first_pass_tier: int = 0):
     """Host-side twin of :func:`solvers.newton.packed_lane_telemetry`
     for the FAILURE path, where the merged result already lives in host
     memory and the strategy column carries the rescue ladder's verdict
-    per lane: same columns, same decade clipping as the device pack."""
+    per lane: same columns, same decade clipping as the device pack.
+    ``first_pass_tier``: the precision-tier code of the FIRST solving
+    pass; stamped only on lanes it accepted (strategy 0, successful,
+    not quarantined) -- every rescue-ladder product is f64 (code 0)."""
     it = np.asarray(res.iterations).astype(np.int32)  # sync-ok: failure path
     ch = getattr(res, "chords", None)
     ch = (np.asarray(ch).astype(np.int32) if ch is not None  # sync-ok: failure path
@@ -1657,17 +1701,23 @@ def _host_lane_telemetry(res, quar, strategy_codes):
     strat = np.where(np.asarray(quar).astype(bool),  # sync-ok: failure path
                      np.int32(STRATEGY_CODES["quarantine"]),
                      np.asarray(strategy_codes, dtype=np.int32))
-    return np.stack([it, ch, dec, strat.astype(np.int32)], axis=-1)
+    strat = strat.astype(np.int32)
+    ok = np.asarray(res.success).astype(bool)  # sync-ok: failure path
+    tcol = np.where(ok & (strat == 0), np.int32(first_pass_tier),
+                    np.int32(0)).astype(np.int32)
+    return np.stack([it, ch, dec, strat, tcol], axis=-1)
 
 
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                   opts: SolverOptions, tof_mask, check_stability: bool,
                   pos_jac_tol: float, backend: Optional[str] = None,
-                  mesh: Optional[Mesh] = None):
+                  mesh: Optional[Mesh] = None, tier: str = "f64"):
     """Shared sweep tail: quarantine, rescue ladder, stability
     verdict/demote loop, TOF/activity -- everything downstream of the
     first solving pass (used by both sweep_steady_state and
-    continuation_sweep).
+    continuation_sweep). ``tier``: the precision tier the FIRST pass
+    ran in -- telemetry bookkeeping only; every rung of the rescue
+    ladder below runs pure f64 regardless.
 
     Sync-lean structure: the quarantine mask, the stability screen, the
     TOF/activity program and every cross-lane count are dispatched
@@ -1721,9 +1771,10 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                 spec, "tof", _tof_program(_prog_spec(spec)),
                 (conds, res.x, mask_arr, ok_spec))
         bundle = _tail_bundle(succ0, quar, amb, demoted, n_neg_dev)
-        tel_dev = _lane_telemetry_bundle(res.iterations,
-                                         getattr(res, "chords", None),
-                                         res.residual)
+        tel_dev = _lane_telemetry_bundle(
+            res.iterations, getattr(res, "chords", None), res.residual,
+            jnp.where(succ0, jnp.int32(_precision.TIER_CODES[tier]),
+                      jnp.int32(0)))
         tel, counts = host_sync((tel_dev, bundle), "sweep tail bundle")
         return (cert, amb, n_amb_dev, tofs, act, tel, counts)
 
@@ -1847,8 +1898,11 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     # The speculative device telemetry pack is stale once the ladder
     # rewrote lanes; rebuild it host-side from the merged result (the
     # failure path pays per-stage syncs anyway) with the ladder's
-    # strategy verdicts in column 3.
-    tel = _host_lane_telemetry(res, quar, strat_h)
+    # strategy verdicts in column 3 and the first pass's tier stamped
+    # on the lanes it accepted (column 4).
+    tel = _host_lane_telemetry(
+        res, quar, strat_h,
+        first_pass_tier=_precision.TIER_CODES[tier])
     out["lane_telemetry"] = tel
     _note_lane_telemetry(tel, spec)
     if check_stability:
@@ -2264,11 +2318,17 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
     has_tof = tof_mask is not None
     mask_arr = jnp.asarray(tof_mask) if has_tof else None
     tail = (mask_arr,) if has_tof else ()
+    # Warm the ACTIVE precision tier's fused program only: the tiered
+    # variant is the f32 bulk + f64 polish as sequential stages of ONE
+    # fused trace (a static branch pair, not a second zoo entry), so
+    # the program count -- and PREWARM_PROGRAM_BUDGET -- is unchanged.
+    # The rescue/jac programs below stay pure f64 under every tier.
+    tier = _precision.active_tier()
     fast_kind = _fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
-                            check_stability, sharding)
+                            check_stability, sharding, tier=tier)
     fast_prog = _fused_sweep_program(pspec, fast_opts, pos_jac_tol,
                                      backend, has_tof, check_stability,
-                                     sharding)
+                                     sharding, tier=tier)
     fast_job = {"kind": fast_kind, "prog": fast_prog,
                 "args": _prog_args(spec,
                                    (conds, _keys_full(), None) + tail),
@@ -2483,12 +2543,13 @@ def warm_from_aot_cache(spec: ModelSpec, conds: Conditions, tof_mask=None,
     keys = jax.random.split(jax.random.PRNGKey(0), n)
     fast_opts = _fast_pass_opts(opts)
     backend = _resolve_backend()
+    tier = _precision.active_tier()
     has_tof = tof_mask is not None
     tail = (jnp.asarray(tof_mask),) if has_tof else ()
     jobs = [(_fused_kind(fast_opts, pos_jac_tol, backend, has_tof,
-                         check_stability),
+                         check_stability, tier=tier),
              _fused_sweep_program(pspec, fast_opts, pos_jac_tol, backend,
-                                  has_tof, check_stability),
+                                  has_tof, check_stability, tier=tier),
              _prog_args(spec, (conds, keys, None) + tail))]
     n_loaded = 0
     for kind, _prog, args in jobs:

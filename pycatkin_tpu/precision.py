@@ -1,0 +1,104 @@
+"""Precision tiers: the one blessed dtype-downcast entry point.
+
+The TPU has no native f64 -- every double-precision FMA is emulated as
+double-float pairs (~16x unit roundoff, 1.519e11 flop/s measured
+ceiling, see docs/perf_mfu.md) -- but it has real f32 matrix units. The
+precision-tier layer exploits that asymmetry: run the Newton/PTC/LM
+bulk iterations in native f32, then polish-and-verify in f64 inside the
+same fused program, so a lane only counts as solved when its f64
+residual and stability verdict pass at the unchanged f64 thresholds
+(``solvers.newton.effective_unit_roundoff`` stays the arbiter). Polish
+failures fall through the existing rescue ladder exactly like an f64
+failure would, so verdicts stay bit-certified while the hot loop runs
+at native speed. docs/perf_precision_tiers.md is the full contract.
+
+This module is the ONLY place solver code may obtain a reduced-
+precision dtype: PCL005 (lint/dtype.py) flags any raw ``float32`` /
+``float64`` literal inside ``ops/`` and ``solvers/``, so every
+downcast is forced through :func:`bulk_dtype` / :func:`cast_bulk` and
+every verify-side upcast through :func:`cast_verify` -- one grep-able
+seam instead of scattered ``astype`` calls.
+
+Selection is process-level configuration, resolved at CALL time (never
+baked into a traced program): ``PYCATKIN_PRECISION_TIER=f32-polish``
+turns the tiered path on; the default is ``f64`` (bitwise-identical to
+the pre-tier solver) until the bench proves the tier on hardware.
+
+Host-side and JAX-free at import (lint/CI tooling imports the tier
+names); ``jax.numpy`` loads lazily inside the cast helpers.
+"""
+
+from __future__ import annotations
+
+import os
+
+TIER_ENV = "PYCATKIN_PRECISION_TIER"
+
+#: Recognised tiers. "f64" = the historical path, every iteration at
+#: full (emulated-on-TPU) double precision. "f32-polish" = bulk
+#: iterations in native f32, then a short f64 polish pass and the f64
+#: verdict inside the same program.
+TIERS = ("f64", "f32-polish")
+
+#: Per-lane telemetry codes (the 5th ``lane_telemetry`` column): which
+#: tier produced the ACCEPTED iterate. 0 = f64 (also every rescue-
+#: ladder product -- the ladder always runs f64), 1 = the f32 bulk +
+#: f64 polish pipeline.
+TIER_CODES = {"f64": 0, "f32-polish": 1}
+TIER_NAMES = tuple(sorted(TIER_CODES, key=TIER_CODES.get))
+
+
+def active_tier() -> str:
+    """The process-level precision tier, resolved from the environment
+    at every call (so tests can flip it without re-importing; program
+    caches key on it via :func:`tier_tag`). Unknown values raise
+    immediately -- a typo must not silently run f64."""
+    tier = os.environ.get(TIER_ENV, "f64").strip() or "f64"
+    if tier not in TIERS:
+        raise ValueError(
+            f"{TIER_ENV}={tier!r}: unknown precision tier "
+            f"(expected one of {', '.join(TIERS)})")
+    return tier
+
+
+def tier_tag(tier: str) -> str:
+    """Program-key / fingerprint suffix for ``tier``. Empty for f64 so
+    every pre-tier program key, AOT cache entry and exported pack stays
+    byte-identical; non-default tiers get a distinct tag so f32 and
+    f64 programs can never share an AOT entry."""
+    return "" if tier == "f64" else ":p32"
+
+
+def tier_of_tag(kind: str) -> str:
+    """Inverse of :func:`tier_tag` over a program kind string: which
+    tier a registered program was built for (the cost ledger keys its
+    roofline on this)."""
+    return "f32-polish" if ":p32" in kind else "f64"
+
+
+def bulk_dtype(tier: str):
+    """The dtype the bulk Newton/PTC/LM iterations run in under
+    ``tier`` -- the blessed PCL005 entry point for reduced precision."""
+    import jax.numpy as jnp
+    return jnp.float32 if tier == "f32-polish" else jnp.float64
+
+
+def verify_dtype():
+    """The dtype every residual verdict and stability certificate is
+    evaluated in -- always full precision, regardless of tier."""
+    import jax.numpy as jnp
+    return jnp.float64
+
+
+def cast_bulk(x, tier: str):
+    """Blessed downcast of an array (or anything ``jnp.asarray``
+    accepts) to the bulk dtype of ``tier``; identity under f64."""
+    import jax.numpy as jnp
+    return jnp.asarray(x, dtype=bulk_dtype(tier))
+
+
+def cast_verify(x):
+    """Blessed upcast back to the verification dtype (f64): the seam
+    between the f32 bulk iterate and the f64 polish-and-verify pass."""
+    import jax.numpy as jnp
+    return jnp.asarray(x, dtype=verify_dtype())

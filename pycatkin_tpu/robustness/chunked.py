@@ -71,11 +71,12 @@ def salvage_arrays(spec, n_lanes: int, tof_mask=None,
         "chords": np.zeros(n_lanes, dtype=np.int32),
     }
     # Packed per-lane telemetry matching the real sweep's columns
-    # (iterations, chords, residual decade, strategy): the lanes were
-    # never solved, so 0 iterations/chords, the +99 non-finite decade
-    # the inf residual encodes to, and the clean strategy code -- no
-    # rescue ran (solvers.newton.LANE_TELEMETRY_FIELDS).
-    tel = np.zeros((n_lanes, 4), dtype=np.int32)
+    # (iterations, chords, residual decade, strategy, tier): the lanes
+    # were never solved, so 0 iterations/chords, the +99 non-finite
+    # decade the inf residual encodes to, the clean strategy code -- no
+    # rescue ran -- and tier 0 since no first-pass acceptance happened
+    # (solvers.newton.LANE_TELEMETRY_FIELDS).
+    tel = np.zeros((n_lanes, 5), dtype=np.int32)
     tel[:, 2] = 99
     out["lane_telemetry"] = tel
     if check_stability:

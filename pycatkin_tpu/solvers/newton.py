@@ -31,6 +31,7 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import precision as _precision
 from ..ops import linalg
 
 
@@ -362,9 +363,13 @@ STRATEGY_CODES = {
 }
 STRATEGY_NAMES = {v: k for k, v in STRATEGY_CODES.items()}
 
-# Column order of the packed per-lane telemetry array.
+# Column order of the packed per-lane telemetry array. ``tier`` records
+# which precision tier produced the ACCEPTED iterate
+# (pycatkin_tpu.precision.TIER_CODES: 0 = f64 -- including every
+# rescue-ladder product, the ladder always runs f64 -- 1 = the f32 bulk
+# + f64 polish pipeline).
 LANE_TELEMETRY_FIELDS = ("iterations", "chords", "residual_decade",
-                         "strategy")
+                         "strategy", "tier")
 
 
 def residual_decade(residual):
@@ -382,19 +387,23 @@ def residual_decade(residual):
     return jnp.clip(dec, -99, 99).astype(jnp.int32)
 
 
-def packed_lane_telemetry(iterations, chords, residual, strategy=0):
-    """Per-lane solver telemetry as ONE ``[n, 4]`` int32 array
+def packed_lane_telemetry(iterations, chords, residual, strategy=0,
+                          tier=0):
+    """Per-lane solver telemetry as ONE ``[n, 5]`` int32 array
     (columns: :data:`LANE_TELEMETRY_FIELDS`). Computed inside the fused
     sweep program so it rides the existing single-sync bundle -- the
     clean path's sync count does not grow by adding lane-resolution
-    telemetry (docs/perf_cost_ledger.md)."""
+    telemetry (docs/perf_cost_ledger.md). ``tier`` (scalar or per-lane)
+    is the precision-tier code of the accepted iterate
+    (:data:`pycatkin_tpu.precision.TIER_CODES`)."""
     it = jnp.asarray(iterations)
     n = it.shape[0]
     ch = (jnp.zeros(n, dtype=jnp.int32) if chords is None
           else jnp.asarray(chords))
     strat = jnp.broadcast_to(jnp.asarray(strategy, dtype=jnp.int32), (n,))
+    tcol = jnp.broadcast_to(jnp.asarray(tier, dtype=jnp.int32), (n,))
     return jnp.stack([it.astype(jnp.int32), ch.astype(jnp.int32),
-                      residual_decade(residual), strat], axis=-1)
+                      residual_decade(residual), strat, tcol], axis=-1)
 
 
 def _verdict(x, fnorm, groups_dyn, opts: SolverOptions):
@@ -499,10 +508,77 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     return x, fnorm, k, lam, jnp.zeros((), dtype=jnp.int32)
 
 
+def bulk_options(opts: SolverOptions, tier: str) -> SolverOptions:
+    """Tolerances the reduced-precision BULK march can actually reach.
+
+    The f64 convergence test divides by ``rate_tol + rate_tol_rel *
+    gross`` with rate_tol_rel ~ 1e-9, but an f32 residual evaluation
+    carries ~eps32 * gross ~ 1.2e-7 * gross of roundoff noise -- two
+    decades ABOVE the f64 denominator, so the f32 march can never
+    satisfy the f64 test; it would burn max_steps grinding against its
+    own noise floor. The bulk therefore runs against tolerances floored
+    at its noise level (~32 eps_bulk relative, 1e-5 absolute): it exits
+    as soon as the iterate is good to f32 accuracy, and the f64 polish
+    pass squares that ~1e-7-relative error into full convergence. Only
+    the bulk march uses these; the verdict ALWAYS uses the caller's
+    original opts. Requires static (non-traced) tolerances -- the
+    tiered path only runs in the statically-shaped fused fast pass."""
+    eps_b = float(jnp.finfo(_precision.bulk_dtype(tier)).eps)
+    return opts._replace(
+        rate_tol=max(float(opts.rate_tol), 1.0e-5),
+        rate_tol_rel=max(float(opts.rate_tol_rel), 32.0 * eps_b))
+
+
+def _polish_newton(fscale_fn, jac_fn, x, groups_dyn,
+                   opts: SolverOptions, steps: int):
+    """Short full-Newton polish at verification precision: ``steps``
+    conservation-constrained Newton iterations from ``x`` (the promoted
+    bulk iterate), each kept only when finite and non-increasing in the
+    caller's ORIGINAL normalized residual -- a diverging polish can
+    therefore never make the iterate worse than the bulk handed over,
+    and a hard lane simply exits unimproved and fails the verdict into
+    the rescue ladder. Same projection (nonneg clamp + group
+    renormalization) as the PTC body, so the polished iterate lives on
+    the same manifold the f64 march walks. Returns (x, fnorm)."""
+    R, M = conservation_constraints(groups_dyn)
+    F, gross = fscale_fn(x)
+    fnorm = _rnorm(F, gross, opts)
+
+    def step(carry, _):
+        x, F, fnorm = carry
+        J = jac_fn(x)
+        B = jnp.where(M[:, None] > 0, R, J)
+        dx = _direction_solve(B, F * (1.0 - M), opts)
+        x_new = _normalize(jnp.maximum(x - dx, 0.0), groups_dyn,
+                           opts.floor)
+        F_new, gross_new = fscale_fn(x_new)
+        fnorm_new = _rnorm(F_new, gross_new, opts)
+        keep = (jnp.isfinite(fnorm_new) & jnp.all(jnp.isfinite(x_new))
+                & (fnorm_new <= fnorm))
+        return (jnp.where(keep, x_new, x),
+                jnp.where(keep, F_new, F),
+                jnp.where(keep, fnorm_new, fnorm)), None
+
+    (x, F, fnorm), _ = jax.lax.scan(step, (x, F, fnorm), None,
+                                    length=steps)
+    return x, fnorm
+
+
+# f64 Newton polish steps after the reduced-precision bulk: each squares
+# the bulk's ~1e-7-relative error (quadratic convergence from inside the
+# Newton basin), so two steps land far below every f64 tolerance; the
+# second buys slack for lanes the bulk left at the edge of its noise
+# floor. More steps only pay f64-emulation cost on already-converged
+# lanes (the monotone keep-test makes them no-ops).
+POLISH_STEPS = 2
+
+
 def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
                  groups_dyn: jnp.ndarray, opts: SolverOptions,
                  key: jnp.ndarray | None = None,
-                 strategy: str = "ptc"):
+                 strategy: str = "ptc",
+                 tier: str = "f64",
+                 bulk_fns: tuple | None = None):
     """Robust steady solve of ``F(x) = 0`` for the dynamic vector.
 
     ``fscale_fn(x) -> (F, gross)``: residual plus per-species gross-flux
@@ -516,6 +592,20 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     branch would execute BOTH solvers for every lane; callers instead
     re-run failed lanes with 'lm' in a second pass (the reference's own
     sequential strategy fallback).
+
+    ``tier`` / ``bulk_fns`` (docs/perf_precision_tiers.md): under
+    ``tier="f32-polish"`` with ``bulk_fns=(bulk_fscale_fn,
+    bulk_jac_fn)`` -- the same closures evaluated at
+    ``precision.bulk_dtype`` -- the whole attempt march (PTC or LM,
+    chords included) runs in native f32 against :func:`bulk_options`
+    tolerances, then :data:`POLISH_STEPS` full-f64 Newton steps polish
+    the promoted iterate and the verdict is taken at the caller's
+    ORIGINAL f64 opts. A lane that cannot be polished to the f64
+    thresholds fails its verdict exactly like an f64 failure and falls
+    through the caller's rescue ladder. The tiered path requires the
+    dedicated static ``max_attempts == 1`` fast pass (the fused sweep's
+    first pass); multi-attempt / traced-pacing solves (the rescue
+    ladder) ignore the tier and stay pure f64.
     Returns (x, success, normalized_residual, iterations, attempts,
     rate_ok, pos_ok, sums_ok, dt_exit, chords) -- the trailing five are
     the per-lane forensic diagnostics of :class:`SteadyStateResults`:
@@ -525,6 +615,39 @@ def solve_steady(fscale_fn: Callable, jac_fn: Callable, x0: jnp.ndarray,
     ``chord_steps=0``).
     """
     attempt_fn = _lm_attempt if strategy == "lm" else _ptc_attempt
+    if (tier != "f64" and bulk_fns is not None
+            and isinstance(opts.max_attempts, int)
+            and opts.max_attempts == 1):
+        # Precision-tiered dedicated path: f32 bulk march, f64
+        # polish-and-verify. Mirrors the single-attempt path below --
+        # same best-of {x0, x1} scoreboard, same verdict at the
+        # caller's opts -- with the expensive march moved to native
+        # matrix units.
+        bulk_fscale_fn, bulk_jac_fn = bulk_fns
+        bopts = bulk_options(opts, tier)
+        F0, gross0 = fscale_fn(x0)
+        f0 = _rnorm(F0, gross0, opts)
+        xb, _, k, dt_exit, chords = attempt_fn(
+            bulk_fscale_fn, bulk_jac_fn, _precision.cast_bulk(x0, tier),
+            _precision.cast_bulk(groups_dyn, tier), bopts)
+        x1, f1 = _polish_newton(fscale_fn, jac_fn,
+                                _precision.cast_verify(xb), groups_dyn,
+                                opts, steps=POLISH_STEPS)
+        ok = _verdict(x1, f1, groups_dyn, opts)
+        better = _score(x1, f1, groups_dyn, opts) > _score(x0, f0,
+                                                          groups_dyn,
+                                                          opts)
+        x_out = jnp.where(ok | better, x1, x0)
+        f_out = jnp.where(ok | better, f1, f0)
+        rate_ok, pos_ok, sums_ok = _verdict_tests(x_out, f_out,
+                                                  groups_dyn, opts)
+        # Polish steps count as iterations (the device work was spent);
+        # dt_exit is promoted so the tiered and plain results share one
+        # output layout (dtype differences would split the vmapped
+        # program's output signature).
+        return (x_out, ok, f_out, k + POLISH_STEPS, jnp.asarray(1),
+                rate_ok, pos_ok, sums_ok,
+                _precision.cast_verify(dt_exit), chords)
     # The consolidated rescue program passes pacing knobs (dt0,
     # max_steps, max_attempts, ...) as traced values so one compiled
     # program serves every ladder rung; a traced max_attempts must take
@@ -649,12 +772,15 @@ LYAPUNOV_MAX_DIM = 8
 
 
 def effective_unit_roundoff(dtype, backend: str | None = None) -> float:
-    """Effective unit roundoff of f64 arithmetic on ``backend``.
+    """Effective unit roundoff of ``dtype`` arithmetic on ``backend``.
 
     CPU and CUDA/ROCm GPUs have native IEEE f64 (finfo eps); anything
     else -- TPU, axon, future accelerators -- is assumed to emulate f64
     as double-f32 pairs with ~49 mantissa bits (constants.py:33), i.e.
-    16x finfo eps per op (sound-first default). ``backend=None`` reads
+    16x finfo eps per op (sound-first default). The emulation factor
+    applies ONLY to 64-bit floats: f32 (the precision-tier bulk dtype)
+    is native on every supported backend, so its roundoff is plain
+    finfo eps everywhere. ``backend=None`` reads
     ``jax.default_backend()`` at CALL time -- callers that own a mesh/
     device set must pass the platform of the devices the program will
     actually run on (ADVICE r5: a program explicitly placed on a
@@ -662,8 +788,9 @@ def effective_unit_roundoff(dtype, backend: str | None = None) -> float:
     and cached programs must not bake in a stale choice)."""
     if backend is None:
         backend = jax.default_backend()
-    native_f64 = backend in ("cpu", "gpu", "cuda", "rocm")
-    return (1.0 if native_f64 else 16.0) * float(jnp.finfo(dtype).eps)
+    native = (backend in ("cpu", "gpu", "cuda", "rocm")
+              or jnp.finfo(dtype).bits < 64)
+    return (1.0 if native else 16.0) * float(jnp.finfo(dtype).eps)
 
 
 def lyapunov_certified_stable(J, Q, tol, eps_eff: float | None = None):

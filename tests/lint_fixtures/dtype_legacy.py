@@ -1,4 +1,4 @@
-"""PCL005 fixture: hardcoded float64 in kernel-style code.
+"""PCL005 fixture: hardcoded float dtypes in kernel-style code.
 
 The checker's scope is ops/ and solvers/; the fixture test calls it
 directly via ``core.lint_file`` (which bypasses scope on purpose).
@@ -15,3 +15,10 @@ def make_scratch(n):
     golden = np.zeros(n, dtype=np.float64)  # pclint: disable=PCL005 -- host-side golden buffer
     inherited = jnp.zeros_like(bad_str)             # fine: inherits
     return bad_attr, bad_str, golden, inherited
+
+
+def sneaky_downcast(x):
+    bad32_attr = x.astype(jnp.float32)              # VIOLATION (attr)
+    bad32_str = jnp.asarray(x, dtype="float32")     # VIOLATION (str)
+    blessed = x.astype(jnp.float32)  # pclint: disable=PCL005 -- fixture: algorithm-intrinsic f32, not a tier choice
+    return bad32_attr, bad32_str, blessed

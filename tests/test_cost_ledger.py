@@ -154,6 +154,51 @@ def test_snapshot_derives_mfu_only_with_a_known_peak():
     assert "achieved_flops_per_s" not in led.snapshot("cpu")["programs"]["idle"]
 
 
+def test_snapshot_scores_tiered_rows_against_their_own_roofline():
+    """Precision-tiered programs (``:p32`` in the kind) score against
+    the native-f32 ceiling, f64 rows against the emulated-f64 one, and
+    the aggregate MFU is the tier-weighted peak budget -- identical to
+    the historical formula when every row is f64
+    (docs/perf_precision_tiers.md)."""
+    peak = costs.device_peak("TPU v5e")
+    f64_peak, f32_peak = peak["flops_per_s"], peak["flops_per_s_f32"]
+    assert f32_peak > f64_peak
+    assert costs.peak_flops_for_tier(peak, "f64") == f64_peak
+    assert costs.peak_flops_for_tier(peak, "f32-polish") == f32_peak
+    assert costs.peak_flops_for_tier(None, "f32-polish") is None
+
+    led = costs.CostLedger()
+    led.record("fused:opts", kind="fused:opts",
+               cost={"flops": f64_peak})
+    led.note_dispatch("fused:opts", 1.0)
+    led.record("fused:opts:p32", kind="fused:opts:p32",
+               cost={"flops": f32_peak})
+    led.note_dispatch("fused:opts:p32", 1.0)
+
+    snap = led.snapshot("TPU v5e")
+    r64 = snap["programs"]["fused:opts"]
+    r32 = snap["programs"]["fused:opts:p32"]
+    assert r64["tier"] == "f64" and r32["tier"] == "f32-polish"
+    # Each row hits 1.0 MFU against its OWN roofline; against the f64
+    # ceiling the f32 row would read a fabricated ~16x.
+    assert r64["mfu"] == pytest.approx(1.0)
+    assert r32["mfu"] == pytest.approx(1.0)
+    assert snap["totals"]["mfu"] == pytest.approx(1.0)
+    assert snap["totals"]["mfu_by_tier"] == {
+        "f32-polish": pytest.approx(1.0), "f64": pytest.approx(1.0)}
+
+    # All-f64 ledger: the tier-weighted budget reduces to the
+    # historical flops / (peak * wall) formula exactly.
+    led2 = costs.CostLedger()
+    led2.record("a", kind="steady:a", cost={"flops": 0.5 * f64_peak})
+    led2.note_dispatch("a", 2.0)
+    snap2 = led2.snapshot("TPU v5e")
+    assert snap2["totals"]["mfu"] == pytest.approx(
+        0.5 * f64_peak / (f64_peak * 2.0))
+    assert snap2["totals"]["mfu_by_tier"] == {
+        "f64": pytest.approx(snap2["totals"]["mfu"])}
+
+
 def test_module_level_ledger_snapshot_probes_live_device():
     costs.record("k", kind="fused", cost={"flops": 4.0})
     costs.note_dispatch("k", 0.5)

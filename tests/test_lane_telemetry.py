@@ -1,7 +1,8 @@
-"""Per-lane solver telemetry: the packed [lanes, 4] diagnostics rows.
+"""Per-lane solver telemetry: the packed [lanes, 5] diagnostics rows.
 
 The fused sweep computes iterations / chords / residual decade /
-rescue-strategy per lane INSIDE the device program, so lane-resolution
+rescue-strategy / accepted-tier per lane INSIDE the device program, so
+lane-resolution
 telemetry rides the existing single "fused tail bundle" sync (the sync
 budget is pinned by tests/test_sync_budget.py). These tests pin the
 content contracts: the packed columns agree with the result arrays the
@@ -18,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pycatkin_tpu import engine
+from pycatkin_tpu import engine, precision
 from pycatkin_tpu.models.synthetic import synthetic_system
 from pycatkin_tpu.obs import export, metrics
 from pycatkin_tpu.parallel import batch
@@ -48,7 +49,7 @@ def test_export_strategy_table_matches_solver_registry():
         assert newton.STRATEGY_CODES[name] == code, name
     assert len(export._STRATEGY_GLYPHS) == len(export.STRATEGY_NAMES)
     assert newton.LANE_TELEMETRY_FIELDS == (
-        "iterations", "chords", "residual_decade", "strategy")
+        "iterations", "chords", "residual_decade", "strategy", "tier")
 
 
 def test_residual_decade_encoding():
@@ -68,7 +69,7 @@ def test_clean_sweep_telemetry_matches_result_arrays(problem):
         "corpus must converge cleanly for this test to mean anything"
     n = np.asarray(conds.T).shape[0]
     tel = np.asarray(out["lane_telemetry"])
-    assert tel.shape == (n, 4) and tel.dtype == np.int32
+    assert tel.shape == (n, 5) and tel.dtype == np.int32
     np.testing.assert_array_equal(
         tel[:, 0], np.asarray(out["iterations"]).astype(np.int32))
     want_ch = (np.asarray(out["chords"]).astype(np.int32)
@@ -78,6 +79,10 @@ def test_clean_sweep_telemetry_matches_result_arrays(problem):
         tel[:, 2],
         np.asarray(newton.residual_decade(jnp.asarray(out["residual"]))))
     np.testing.assert_array_equal(tel[:, 3], 0)   # nothing was rescued
+    # Every first-pass acceptance carries the AMBIENT tier's code (the
+    # CI precision-tier lane runs this file under f32-polish).
+    np.testing.assert_array_equal(
+        tel[:, 4], precision.TIER_CODES[precision.active_tier()])
 
     # The pack fed the per-lane histograms, labeled by ABI bucket.
     hists = metrics.snapshot()["histograms"]
@@ -109,10 +114,14 @@ def test_fused_and_legacy_telemetry_bit_identical(problem, monkeypatch):
         "fused/legacy sweeps disagree on the packed lane telemetry"
 
 
-def test_rescue_path_stamps_strategy_codes(problem):
+def test_rescue_path_stamps_strategy_codes(problem, monkeypatch):
     """Crippled pacing fails real lanes in the fast pass; the rescue
     merge must stamp ladder codes on exactly the rescued lanes while
-    fast-pass survivors keep code 0 and quarantined lanes read 6."""
+    fast-pass survivors keep code 0 and quarantined lanes read 6.
+    Pinned to the f64 tier: under f32-polish the crippled corpus
+    converges first pass (tests/test_precision_tiers.py measures
+    that), so the drill's premise needs the plain f64 fast pass."""
+    monkeypatch.setenv(precision.TIER_ENV, "f64")
     spec, conds, mask = problem
     opts = SolverOptions(max_steps=6, max_attempts=2)
     n = np.asarray(conds.T).shape[0]
@@ -137,6 +146,12 @@ def test_rescue_path_stamps_strategy_codes(problem):
         "a rescued lane still reads clean"
     np.testing.assert_array_equal(
         strat[quar], newton.STRATEGY_CODES["quarantine"])
+    # Every rescue product is an f64 iterate (tier code 0); only the
+    # fast-pass survivors carry the ambient tier's code.
+    np.testing.assert_array_equal(
+        tel[:, 4],
+        np.where((strat == 0) & ~quar,
+                 precision.TIER_CODES[precision.active_tier()], 0))
 
     # The failure-path (host-twin) columns still agree with the merged
     # result arrays -- same contract as the clean device pack.
@@ -155,10 +170,12 @@ def test_rescue_path_stamps_strategy_codes(problem):
 
 
 def test_lane_rows_reject_malformed_telemetry():
-    with pytest.raises(ValueError, match="expected 4"):
+    with pytest.raises(ValueError, match="expected 5"):
         export.lane_summary([[1, 2, 3]])
     assert export.lane_summary([]) == {"lanes": 0}
     # Out-of-table codes render as '?' / 'codeN' instead of crashing.
-    tel = [[3, 0, -8, 42]]
-    assert export.lane_summary(tel)["strategies"] == {"code42": 1}
+    tel = [[3, 0, -8, 42, 7]]
+    s = export.lane_summary(tel)
+    assert s["strategies"] == {"code42": 1}
+    assert s["tiers"] == {"code7": 1}
     assert "?" in export.format_lane_heatmap(tel)

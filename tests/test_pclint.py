@@ -213,11 +213,15 @@ def test_jit_closure_factory_is_detected():
 def test_dtype_fixture():
     findings = lint_file(DtypeChecker(), fx("dtype_legacy.py"))
     act = active(findings)
-    assert len(act) == 2
+    assert len(act) == 4
     assert any("np.float64" in f.message for f in act)
     assert any("\"float64\" dtype literal" in f.message for f in act)
+    assert any("jnp.float32" in f.message
+               and "precision-tier" in f.message for f in act)
+    assert any("\"float32\" dtype literal" in f.message for f in act)
     sup = inline(findings)
-    assert len(sup) == 1 and "golden buffer" in sup[0].reason
+    assert len(sup) == 2 and any("golden buffer" in s.reason
+                                 for s in sup)
 
 
 # ---------------------------------------------------------------- PCL006

@@ -74,6 +74,32 @@ def test_flag_regressions_min_history_and_rel_floor_gates():
                                  rel_floor=0.2) == []
 
 
+def test_flag_regressions_segments_history_by_backend():
+    """A CPU round compared against TPU throughput history would flag
+    a 100x 'regression' that is really a hardware change: baselines
+    must only ever mix same-backend rounds, and records predating the
+    backend field count as TPU (every checked-in round before it was
+    a v5e run)."""
+    tpu_history = _entries([10000.0, 10120.0, 9910.0, 10050.0])
+    cpu_cand = {"backend": "cpu", "value": 900.0}
+    # Legacy entries (no backend anywhere) default to TPU...
+    assert hist.record_backend({}) == "tpu"
+    assert hist.record_backend({"parsed": {"backend": "cpu"}}) == "cpu"
+    # ...so the CPU candidate has zero same-backend history: silence,
+    # not a 10x finding.
+    assert hist.flag_regressions(tpu_history, cpu_cand) == []
+    # With enough CPU rounds on file, a real CPU regression still
+    # flags -- the TPU entries are simply not its baseline.
+    mixed = tpu_history + [
+        {"backend": "cpu", "metrics": {"value": v}}
+        for v in (900.0, 905.0, 897.0, 902.0)]
+    found = hist.flag_regressions(mixed, {"backend": "cpu",
+                                          "value": 450.0})
+    assert len(found) == 1 and found[0]["n_history"] == 4
+    # And a TPU candidate keeps ignoring the CPU rounds.
+    assert hist.flag_regressions(mixed, {"value": 9950.0}) == []
+
+
 def test_attribution_names_span_and_program_drops():
     prior = {"record": {"cost_ledger": {"programs": {
         "fused-key": {"label": "fused sweep", "mfu": 0.30},

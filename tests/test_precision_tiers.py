@@ -1,0 +1,392 @@
+"""Precision-tiered solves (PYCATKIN_PRECISION_TIER=f32-polish).
+
+The tier runs the Newton bulk march in native f32 and accepts a lane
+only after a short f64 polish pass satisfies the caller's ORIGINAL f64
+verdict (docs/perf_precision_tiers.md). These tests pin the contract
+that makes the tier safe to flip on:
+
+1.  VERDICT INVARIANCE -- on the clean, rescue, quarantine and
+    stability-escalation corpora, every verdict/mask output of a sweep
+    (solved / rescued / quarantined / stability, plus the telemetry
+    strategy column) is BITWISE identical to a pure-f64 run.
+    Continuous outputs agree like two independently converged
+    solutions -- to the solver tolerance, not to the ulp (measured
+    envelope below); per-lane iteration counts track the tier's own
+    trajectory and are explicitly NOT part of the contract.
+
+2.  FALL-THROUGH -- a lane the polish cannot carry to the f64
+    thresholds is an ordinary first-pass failure: it rides the
+    existing (pure-f64) rescue ladder, and the telemetry tier column
+    stamps the f64 code on every ladder product.
+
+3.  IDENTITY -- f32 and f64 programs never share a cache entry: kind
+    strings and ABI fingerprints carry the ``:p32`` tag (and the f64
+    tag is empty, so every pre-tier key stays byte-identical).
+
+4.  COST -- the tiered fused clean sweep still costs exactly one
+    counted host sync (the bulk, the polish and the verdict are stages
+    of ONE fused program).
+"""
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine, precision
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import batch
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         clear_program_caches,
+                                         sweep_steady_state)
+from pycatkin_tpu.solvers import newton
+from pycatkin_tpu.solvers.newton import SolverOptions
+from pycatkin_tpu.utils import profiling
+
+N_LANES = 32
+
+# Measured on this corpus (CPU): the two tiers converge to the same
+# root along different trajectories, so steady states agree like two
+# independent converged solutions -- y maxrel ~1.1e-2 observed. The
+# net TOF is a difference of large cancelling gross fluxes; on this
+# corpus the masked step sits at equilibrium (|tof| < 1e-9 against
+# O(1) gross fluxes), so tof is sub-tolerance cancellation noise under
+# EITHER tier and gets an absolute noise-floor envelope; activity
+# (its log10 rendering) is only compared where the tof is above that
+# floor.
+_Y_TOL = dict(rtol=5e-2, atol=1e-12)
+_SCALE_REL = 5e-2
+_TOF_NOISE = 1e-7
+
+# Outputs that track the tier's own solve trajectory rather than the
+# physics: the f32 march legitimately takes a different iteration/chord
+# count and exits with a different pseudo-step and residual norm.
+_TRAJECTORY_INTS = frozenset({"iterations"})
+_TRAJECTORY_FLOATS = frozenset({"residual", "dt_exit"})
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=16, n_reactions=24, seed=3)
+    spec = sim.spec
+    conds = broadcast_conditions(sim.conditions(), N_LANES)
+    conds = conds._replace(T=np.linspace(480.0, 620.0, N_LANES))
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    return spec, conds, mask, sim.solver_options()
+
+
+def _run_tiers(monkeypatch, spec, conds, mask=None, **kwargs):
+    """(f64 reference, f32-polish result, f32 run's sync labels).
+
+    No cache clearing: the tier rides the program kind / fingerprint,
+    so the two runs select different cached programs by construction --
+    that IS part of what these tests exercise."""
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    ref = sweep_steady_state(spec, conds, tof_mask=mask, **kwargs)
+    monkeypatch.setenv(precision.TIER_ENV, "f32-polish")
+    with profiling.sync_budget() as budget:
+        out = sweep_steady_state(spec, conds, tof_mask=mask, **kwargs)
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    return ref, out, budget.labels
+
+
+def _assert_tier_equivalent(ref: dict, out: dict):
+    """Verdicts/masks bitwise, floats to the measured envelope,
+    trajectory diagnostics exempt (see module docstring)."""
+    assert sorted(ref.keys()) == sorted(out.keys())
+    for k in sorted(ref.keys()):
+        a, b = np.asarray(ref[k]), np.asarray(out[k])
+        assert a.shape == b.shape, f"{k}: {a.shape} vs {b.shape}"
+        assert a.dtype == b.dtype, k
+        if k == "lane_telemetry":
+            # The strategy column is a verdict (which ladder rung
+            # produced each lane); the other columns track the tier's
+            # own trajectory, and the tier column differs BY DESIGN.
+            assert a[:, 3].tobytes() == b[:, 3].tobytes(), (
+                "telemetry strategy column differs between tiers")
+            continue
+        if a.dtype.kind in "biu":
+            if k in _TRAJECTORY_INTS:
+                continue
+            assert a.tobytes() == b.tobytes(), (
+                f"verdict/mask output {k!r} differs between f64 and "
+                f"f32-polish")
+        elif k in _TRAJECTORY_FLOATS:
+            continue
+        elif k == "y":
+            np.testing.assert_allclose(b, a, err_msg=k, **_Y_TOL)
+        elif k == "tof":
+            np.testing.assert_allclose(b, a, err_msg=k,
+                                       rtol=_SCALE_REL, atol=_TOF_NOISE)
+        elif k == "activity":
+            sig = np.abs(np.asarray(ref["tof"])) > _TOF_NOISE
+            np.testing.assert_allclose(b[sig], a[sig], err_msg=k,
+                                       rtol=0, atol=0.1)
+        else:
+            scale = float(max(np.abs(a).max(initial=0.0),
+                              np.abs(b).max(initial=0.0)))
+            np.testing.assert_allclose(b, a, err_msg=k, rtol=0,
+                                       atol=_SCALE_REL * scale + 1e-300)
+
+
+# ---------------------------------------------------------------------------
+# the tier layer itself
+
+
+def test_tier_registry_and_helpers(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    assert precision.active_tier() == "f64"
+    for tier in precision.TIERS:
+        monkeypatch.setenv(precision.TIER_ENV, tier)
+        assert precision.active_tier() == tier
+    monkeypatch.setenv(precision.TIER_ENV, "f16-yolo")
+    with pytest.raises(ValueError, match="f16-yolo"):
+        precision.active_tier()
+
+    # tag <-> tier roundtrip; the f64 tag MUST be empty so every
+    # pre-tier program key / fingerprint / AOT pack stays byte-equal.
+    assert precision.tier_tag("f64") == ""
+    assert precision.tier_of_tag("steady:ptc:SolverOptions(...)") == "f64"
+    tag = precision.tier_tag("f32-polish")
+    assert tag and precision.tier_of_tag(f"steady:x{tag}") == "f32-polish"
+
+    assert precision.bulk_dtype("f64") == jnp.float64
+    assert precision.bulk_dtype("f32-polish") == jnp.float32
+    assert precision.verify_dtype() == jnp.float64
+    assert sorted(precision.TIER_CODES) == sorted(precision.TIERS)
+    for tier, code in precision.TIER_CODES.items():
+        assert precision.TIER_NAMES[code] == tier
+
+
+def test_bulk_options_floors_tolerances():
+    """The f32 bulk march must not grind against its own roundoff
+    noise: tolerances are floored at the bulk dtype's noise level,
+    while an f64 'bulk' keeps the caller's tolerances (the floors are
+    below any realistic f64 setting)."""
+    import jax.numpy as jnp
+
+    opts = SolverOptions(rate_tol=1e-10, rate_tol_rel=1e-9)
+    b = newton.bulk_options(opts, "f32-polish")
+    assert b.rate_tol >= 1e-5
+    assert b.rate_tol_rel >= 32.0 * float(jnp.finfo(jnp.float32).eps)
+    loose = SolverOptions(rate_tol=1e-3, rate_tol_rel=1e-2)
+    b2 = newton.bulk_options(loose, "f32-polish")
+    assert b2.rate_tol == loose.rate_tol
+    assert b2.rate_tol_rel == loose.rate_tol_rel
+
+
+def test_program_identity_carries_tier_tag(problem, monkeypatch):
+    spec, _, _, opts = problem
+
+    k64 = batch._steady_kind(opts, "ptc")
+    k32 = batch._steady_kind(opts, "ptc", tier="f32-polish")
+    assert ":p32" not in k64
+    assert k32 == k64 + ":p32"
+    f64k = batch._fused_kind(opts, 1e-2, "cpu", True, True)
+    f32k = batch._fused_kind(opts, 1e-2, "cpu", True, True,
+                             tier="f32-polish")
+    assert f32k != f64k and ":p32" in f32k and ":p32" not in f64k
+
+    # ABI: the tiers intern as DIFFERENT buckets -- distinct statics,
+    # fingerprints and program-spec identities, so an f32 program can
+    # never be served from an f64 AOT entry (or vice versa).
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    low64 = abi.lower_spec(spec)
+    monkeypatch.setenv(precision.TIER_ENV, "f32-polish")
+    low32 = abi.lower_spec(spec)
+    assert low64.program_spec.static.precision == "f64"
+    assert low32.program_spec.static.precision == "f32-polish"
+    assert ":p32" not in low64.abi_fingerprint
+    assert low32.abi_fingerprint == low64.abi_fingerprint + ":p32"
+    assert low32.program_spec is not low64.program_spec
+
+
+# ---------------------------------------------------------------------------
+# verdict invariance on the sweep corpora
+
+
+def test_clean_corpus_matches_f64_in_one_sync(problem, monkeypatch):
+    spec, conds, mask, opts = problem
+    ref, out, labels = _run_tiers(monkeypatch, spec, conds, mask,
+                                  opts=opts, check_stability=True)
+    assert bool(np.all(np.asarray(ref["success"]))), \
+        "corpus must converge cleanly for this test to mean anything"
+    _assert_tier_equivalent(ref, out)
+
+    # The tiered fused clean sweep is still ONE fused program and
+    # exactly one counted host sync -- the f64 polish is an in-program
+    # stage, not a second dispatch.
+    assert labels == ["fused tail bundle"]
+
+    # The telemetry tier column: every accepted lane came from the
+    # f32-polish first pass; the reference is all-f64.
+    tel64 = np.asarray(ref["lane_telemetry"])
+    tel32 = np.asarray(out["lane_telemetry"])
+    np.testing.assert_array_equal(tel64[:, 4], 0)
+    np.testing.assert_array_equal(
+        tel32[:, 4], precision.TIER_CODES["f32-polish"])
+
+    from pycatkin_tpu.obs import export
+    assert export.lane_summary(tel32)["tiers"] == {"f32-polish": N_LANES}
+    assert export.lane_summary(tel64)["tiers"] == {"f64": N_LANES}
+
+
+def test_demote_rescue_corpus_matches_f64(monkeypatch):
+    """Rescue-ladder corpus: a lane seeded ON an unstable root
+    converges there under both tiers, fails the (always-f64) stability
+    verdict, and must ride the demote/re-solve ladder to the SAME
+    rung -- strategy codes bitwise, ladder product stamped f64."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu.parallel.batch import stack_conditions
+    from tests.test_verdicts import A_STABLE, A_UNSTABLE, _full_y
+    from tests.test_verdicts import bistable as _bistable_fixture
+
+    sim = _bistable_fixture.__wrapped__()
+    spec = sim.spec
+    dyn = np.asarray(spec.dynamic_indices)
+    conds = stack_conditions([sim.conditions()] * 3)
+    x0 = jnp.asarray(np.stack([_full_y(sim, A_UNSTABLE)[dyn],
+                               _full_y(sim, A_STABLE)[dyn],
+                               _full_y(sim, 0.0)[dyn]]))
+    ref, out, _ = _run_tiers(monkeypatch, spec, conds, None, x0=x0,
+                             check_stability=True)
+    strat = np.asarray(ref["lane_telemetry"])[:, 3]
+    assert np.any(strat >= 1), \
+        "corpus produced no rescued lanes -- the ladder was not " \
+        "exercised"
+    _assert_tier_equivalent(ref, out)
+    tel32 = np.asarray(out["lane_telemetry"])
+    # First-pass acceptances carry the f32 code, every ladder product
+    # the f64 code -- lane-exact.
+    np.testing.assert_array_equal(
+        tel32[:, 4],
+        np.where(tel32[:, 3] == 0,
+                 precision.TIER_CODES["f32-polish"], 0))
+
+
+def test_crippled_pacing_first_pass_is_stronger_not_different(
+        problem, monkeypatch):
+    """Under a crippled step budget the f64 fast pass fails every lane
+    into the ladder, while the f32 bulk (whose floored tolerances need
+    fewer steps) plus the f64 polish legitimately accepts them first
+    pass -- the which-rung forensics differ BY DESIGN under artificial
+    pacing cripples. What must still hold: the FINAL verdict masks are
+    bitwise tier-invariant, every f32 acceptance passed the same f64
+    thresholds (that is the acceptance rule), and the steady states
+    agree to the converged-solution envelope."""
+    spec, conds, mask, _ = problem
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    ref, out, _ = _run_tiers(monkeypatch, spec, conds, mask, opts=opts,
+                             check_stability=True)
+    st64 = np.asarray(ref["lane_telemetry"])[:, 3]
+    tel32 = np.asarray(out["lane_telemetry"])
+    assert np.all(st64 >= 1), \
+        "cripple too weak -- the f64 fast pass still converged lanes"
+    assert np.all(tel32[:, 3] == 0) and np.all(
+        tel32[:, 4] == precision.TIER_CODES["f32-polish"])
+    for k in ("success", "stable", "quarantined", "rate_ok", "pos_ok",
+              "sums_ok"):
+        assert (np.asarray(ref[k]).tobytes()
+                == np.asarray(out[k]).tobytes()), k
+    np.testing.assert_allclose(np.asarray(out["y"]),
+                               np.asarray(ref["y"]), **_Y_TOL)
+
+
+@pytest.mark.faults
+def test_quarantine_corpus_matches_f64(problem, monkeypatch):
+    """A NaN-poisoned lane is quarantined and re-solved identically
+    under both tiers (the fault plan forces the legacy split tail in
+    both, so this also covers the non-fused tiered first pass)."""
+    from pycatkin_tpu.robustness import FaultPlan, FaultSpec, fault_scope
+
+    spec, conds, mask, opts = problem
+    plan = FaultPlan([FaultSpec(site="batched steady solve",
+                                kind="nan", lanes=(7,), times=None)])
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    with fault_scope(plan):
+        ref = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                                 check_stability=True)
+    monkeypatch.setenv(precision.TIER_ENV, "f32-polish")
+    plan2 = FaultPlan([FaultSpec(site="batched steady solve",
+                                 kind="nan", lanes=(7,), times=None)])
+    with fault_scope(plan2):
+        out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                                 check_stability=True)
+    assert bool(np.asarray(ref["quarantined"])[7]), \
+        "poison did not land -- quarantine path not exercised"
+    _assert_tier_equivalent(ref, out)
+
+
+def test_stability_escalation_matches_f64(problem, monkeypatch):
+    """Force tier-0 certificate abstention (same device-side threshold
+    pin as tests/test_tiered_screen.py) so every converged lane rides
+    the host eigensolve escalation -- the stability verdicts must stay
+    tier-invariant through that path too."""
+    spec, conds, mask, opts = problem
+    orig = newton.stability_tolerance_from_scale
+
+    def tier0_never_certifies(scale, pos_tol=1e-2, eps=None):
+        t = orig(scale, pos_tol, eps)
+        return t - 2.0 * scale if eps is None else t
+
+    monkeypatch.setattr(newton, "stability_tolerance_from_scale",
+                        tier0_never_certifies)
+    monkeypatch.setattr(newton, "LYAPUNOV_MAX_DIM", 0)
+    # Off-default pos_jac_tol -> fresh cache keys, so a
+    # previously-compiled program cannot carry the real threshold.
+    ref, out, labels = _run_tiers(monkeypatch, spec, conds, mask,
+                                  opts=opts, check_stability=True,
+                                  pos_jac_tol=0.02)
+    assert "tier-0 escalation masks" in labels, \
+        "escalation path was not exercised under f32-polish"
+    _assert_tier_equivalent(ref, out)
+
+
+# ---------------------------------------------------------------------------
+# fall-through: polish failure is an ordinary first-pass failure
+
+
+def test_polish_failure_falls_through_ladder(problem, monkeypatch):
+    """Hard-lane drill: with the polish budget pinned to zero steps the
+    raw f32 iterate cannot meet the f64 thresholds, so first-pass
+    acceptance must be REFUSED and the lanes must ride the ordinary
+    f64 rescue ladder to the same final verdicts -- the acceptance rule
+    (f64 residual + verdict at the caller's opts) is what makes the
+    tier safe, and this proves it actually gates."""
+    spec, conds, mask, opts = problem
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    ref = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                             check_stability=True)
+
+    monkeypatch.setattr(newton, "POLISH_STEPS", 0)
+    monkeypatch.setenv(precision.TIER_ENV, "f32-polish")
+    # POLISH_STEPS is baked at trace time and the kind strings do not
+    # key on it: drop the compiled programs around the patched run.
+    clear_program_caches()
+    try:
+        out = sweep_steady_state(spec, conds, tof_mask=mask, opts=opts,
+                                 check_stability=True)
+    finally:
+        clear_program_caches()
+
+    # Same final verdicts -- the ladder absorbed every polish failure.
+    for k in ("success", "stable", "quarantined"):
+        assert (np.asarray(ref[k]).tobytes()
+                == np.asarray(out[k]).tobytes()), k
+
+    tel = np.asarray(out["lane_telemetry"])
+    strat, tier = tel[:, 3], tel[:, 4]
+    assert np.any(strat >= 1), (
+        "no lane fell through to the ladder -- the unpolished f32 "
+        "iterate passed the f64 verdict, so this drill proves nothing")
+    # Ladder products are f64 (code 0); any lane the raw bulk iterate
+    # DID carry over the f64 bar is a legitimate first-pass accept and
+    # keeps the f32 code.
+    np.testing.assert_array_equal(
+        tier,
+        np.where(strat == 0, precision.TIER_CODES["f32-polish"], 0))

@@ -118,11 +118,12 @@ def selftest(sweep: bool = False) -> int:
 
     # 5. Lane telemetry heatmap on synthetic packed rows.
     from pycatkin_tpu.obs import format_lane_heatmap, lane_summary
-    tel = [[4, 0, -10, 0], [9, 3, -8, 2], [30, 6, -3, 6],
-           [5, 0, -11, 0]]
+    tel = [[4, 0, -10, 0, 1], [9, 3, -8, 2, 0], [30, 6, -3, 6, 0],
+           [5, 0, -11, 0, 1]]
     s = lane_summary(tel)
     if (s["lanes"] != 4 or s["strategies"].get("quarantine") != 1
-            or s["iterations"]["max"] != 30):
+            or s["iterations"]["max"] != 30
+            or s["tiers"].get("f32-polish") != 2):
         return _fail(f"lane summary wrong: {s}")
     heat = format_lane_heatmap(tel, width=2)
     if ".t" not in heat or "#." not in heat:
