@@ -604,14 +604,147 @@ def main():
     print(json.dumps(result))
 
 
+def packed_batch_scenario(ks=None, n_lanes=8):
+    """Packed multi-tenant batching scenario (ISSUE-12,
+    docs/perf_packed_batching.md): for each K in ``ks`` (default
+    1,2,4,8; override with BENCH_PACKED_KS), sweep K same-bucket
+    synthetic mechanisms as ONE packed dispatch, recording pack
+    occupancy, the marginal compile bill of a SECOND fresh-mechanism
+    pack in the warm ``(bucket, K, lanes)`` cell (contract: zero for
+    K>1), the one-counted-sync contract and per-tenant pts/s; the
+    largest K is also checked bitwise against per-tenant solo sweeps.
+    K=1 rides the byte-identical solo delegation and serves as the
+    throughput baseline. Returns a record dict whose ``packed_ok`` is
+    the --smoke hard gate."""
+    if ks is None:
+        ks = tuple(int(s) for s in os.environ.get(
+            "BENCH_PACKED_KS", "1,2,4,8").split(","))
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.frontend import abi
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                             packed_sweep_steady_state,
+                                             prewarm_packed_sweep_programs,
+                                             sweep_steady_state)
+    from pycatkin_tpu.utils import profiling
+
+    def _tenants(k, base):
+        out = []
+        for i in range(k):
+            sim = synthetic_system(n_species=12, n_reactions=14,
+                                   seed=base + i)
+            conds = broadcast_conditions(sim.conditions(), n_lanes)
+            conds = conds._replace(
+                T=np.linspace(430.0, 720.0, n_lanes) + 2.0 * i)
+            mask = engine.tof_mask_for(sim.spec,
+                                       [sim.spec.rnames[-1]])
+            out.append((sim.spec, conds, mask))
+        return out
+
+    # The scenario is about the packed path, so it forces the ABI gate
+    # on for its own duration regardless of the ambient mode (restored
+    # below -- the manifest env gate audits the post-scenario state).
+    prev_abi = os.environ.get(abi.ABI_ENV)
+    os.environ[abi.ABI_ENV] = "1"
+    rows, failures = [], []
+    try:
+        for k in ks:
+            tenants = _tenants(k, base=1000 * k)
+            specs = [t[0] for t in tenants]
+            conds_l = [t[1] for t in tenants]
+            masks = [t[2] for t in tenants]
+            row = {"k": int(k)}
+            if k > 1:
+                kb = 1 << max(0, (k - 1).bit_length())
+                row["k_bucket"] = kb
+                row["pack_occupancy"] = k / kb
+                t0 = time.perf_counter()
+                prewarm_packed_sweep_programs(specs, conds_l,
+                                              tof_mask=masks,
+                                              check_stability=True)
+                row["prewarm_s"] = round(time.perf_counter() - t0, 2)
+                fresh = _tenants(k, base=1000 * k + 500)
+                n_m = prewarm_packed_sweep_programs(
+                    [t[0] for t in fresh], [t[1] for t in fresh],
+                    tof_mask=[t[2] for t in fresh],
+                    check_stability=True)
+                row["marginal_compiled"] = int(n_m.compiled)
+                if n_m.compiled:
+                    failures.append(
+                        f"K={k}: a fresh-mechanism pack in the warm "
+                        f"bucket compiled {int(n_m.compiled)} "
+                        f"program(s) (must be 0)")
+            # Warm (uncounted) dispatch, then the timed one under the
+            # sync budget. K=1 is the solo delegation by contract.
+            packed_sweep_steady_state(specs, conds_l, tof_mask=masks,
+                                      check_stability=True)
+            profiling.reset_sync_count()
+            t0 = time.perf_counter()
+            with profiling.sync_budget() as budget:
+                outs = packed_sweep_steady_state(specs, conds_l,
+                                                 tof_mask=masks,
+                                                 check_stability=True)
+            wall = time.perf_counter() - t0
+            n_ok = int(sum(int(np.sum(np.asarray(o["success"])))
+                           for o in outs))
+            row.update({
+                "wall_s": round(wall, 4),
+                "sync_count": budget.count,
+                "sync_labels": budget.labels,
+                "converged": n_ok,
+                "pts_per_s_per_tenant": round(n_lanes / wall, 1),
+                "pts_per_s_total": round(k * n_lanes / wall, 1),
+            })
+            if n_ok != k * n_lanes:
+                failures.append(f"K={k}: {n_ok}/{k * n_lanes} lanes "
+                                f"converged")
+            if k > 1 and (budget.count != 1 or budget.labels
+                          != ["packed fused tail bundle"]):
+                failures.append(
+                    f"K={k}: packed clean sweep spent {budget.count} "
+                    f"counted sync(s) {budget.labels} (contract: "
+                    f"exactly 1)")
+            if k > 1 and k == max(ks):
+                mismatched = []
+                for i, (s, c, m) in enumerate(tenants):
+                    solo = sweep_steady_state(s, c, tof_mask=m,
+                                              check_stability=True)
+                    for key in sorted(set(solo) | set(outs[i])):
+                        if key not in solo or key not in outs[i]:
+                            mismatched.append(f"tenant {i}: {key}")
+                            continue
+                        a = np.asarray(solo[key])
+                        b = np.asarray(outs[i][key])
+                        if (a.dtype != b.dtype or a.shape != b.shape
+                                or a.tobytes() != b.tobytes()):
+                            mismatched.append(f"tenant {i}: {key}")
+                row["equiv_ok"] = not mismatched
+                if mismatched:
+                    failures.append(
+                        f"K={k}: packed != solo bitwise: "
+                        + ", ".join(mismatched))
+            rows.append(row)
+    finally:
+        if prev_abi is None:
+            os.environ.pop(abi.ABI_ENV, None)
+        else:
+            os.environ[abi.ABI_ENV] = prev_abi
+    return {"ks": [int(k) for k in ks], "n_lanes": n_lanes,
+            "rows": rows, "failures": failures,
+            "packed_ok": not failures}
+
+
 def smoke_main():
     """``bench.py --smoke``: the ``make bench-smoke`` CI lane. The
     pclint static-analysis gate followed by an 8x8 sweep with prewarm
     on whatever backend is available (CPU in CI), exiting non-zero on
     any new lint finding, any crash, a clean sweep spending more
     than 2 counted host syncs (the fused single-dispatch tail spends
-    exactly 1), a prewarmed program missing its cost-ledger row, or a
-    sweep output missing its per-lane telemetry bundle -- the cheap
+    exactly 1), a prewarmed program missing its cost-ledger row, a
+    sweep output missing its per-lane telemetry bundle, or a breach of
+    the packed multi-tenant contracts (zero marginal compiles, one
+    sync, bitwise-vs-solo; ``packed_ok``) -- the cheap
     end-to-end canary that the correctness gates and the pipelined
     executor survive integration, not a throughput record. Prints
     exactly one JSON line."""
@@ -732,6 +865,18 @@ def smoke_main():
             else:
                 os.environ[precision.TIER_ENV] = tier_prev
         tier_ok = tier_err is None
+
+        # Packed-batch gate (ISSUE-12): K same-bucket mechanisms as one
+        # dispatch each, with the zero-marginal-compile, one-sync and
+        # bitwise-vs-solo contracts hard-failed below
+        # (docs/perf_packed_batching.md). Runs inside the scratch AOT
+        # cache block so the packed executables never touch the repo
+        # cache.
+        try:
+            packed = packed_batch_scenario()
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            packed = {"error": str(e), "packed_ok": False}
+        packed_ok = bool(packed.get("packed_ok"))
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -861,6 +1006,8 @@ def smoke_main():
                                    else None),
         "abi_marginal_compiled": abi_marginal_compiled,
         "abi_zero_compile_ok": abi_zero_compile_ok,
+        "packed": packed,
+        "packed_ok": packed_ok,
         "lint_ok": True,
         "lint_findings": 0,
         "trace_ok": trace_ok,
@@ -916,6 +1063,12 @@ def smoke_main():
         return 1
     if not tier_ok:
         log(f"bench-smoke: FAIL -- precision-tier gate: {tier_err}")
+        return 1
+    if not packed_ok:
+        detail = (packed.get("error")
+                  or "; ".join(packed.get("failures") or ())
+                  or "no rows")
+        log(f"bench-smoke: FAIL -- packed-batch gate: {detail}")
         return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "

@@ -308,7 +308,20 @@ def steady_state(spec: ModelSpec, cond: Conditions,
     is built at the bulk dtype and the solver runs its march there,
     polishing and verdicting in f64; only the static single-attempt
     fast pass uses it (newton.solve_steady gates), so rescue solves
-    through this same entry point stay pure f64."""
+    through this same entry point stay pure f64.
+
+    Batching contract: this function is nested under up to TWO vmap
+    levels by the sweep layer -- lanes (conditions) and, for packed
+    multi-tenant buckets, tenants (mechanism operands,
+    parallel/batch.py's packed fused program). Per-lane bit-identity
+    across those nestings is what the packed-batching acceptance gate
+    pins, and it holds because every data-dependent loop in here and in
+    newton.solve_steady is a ``lax.while_loop``/``lax.cond`` whose
+    batching rule select-masks finished elements without changing any
+    element's arithmetic, and no reduction ever crosses the lane or
+    tenant axis. Do not introduce cross-lane reductions, host callbacks
+    or lane-position-dependent logic in this call tree; they would
+    break the tenant-packing equivalence silently."""
     kf, kr, _ = rate_constants(spec, cond)
     fscale, dyn, y_base = _dynamic_fscale(spec, cond, kf, kr)
     jac = jax.jacfwd(lambda x: fscale(x)[0])

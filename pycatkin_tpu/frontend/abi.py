@@ -490,6 +490,141 @@ def _pad_last(a, pad: int, fill):
 
 
 # ----------------------------------------------------------------------
+# multi-tenant packing
+
+class PackedLowered:
+    """K same-bucket :class:`AbiLowered` mechanisms stacked along a new
+    leading *tenant* axis -- the multi-tenant unit the packed fused
+    sweep program (parallel/batch.py) dispatches as ONE device program.
+
+    The tenant axis is padded to a power of two (``k_bucket``) with
+    *ghost tenants* that replicate tenant 0's operands and inputs, so
+    packed program shapes -- and therefore compile_pool keys -- form a
+    small closed family per bucket instead of one per occupancy. Ghost
+    results are simply never unpacked. ``k_bucket == 1`` is the
+    degenerate pack; callers (``packed_sweep_steady_state``) route it
+    through the ordinary solo path so every pre-packing program key,
+    AOT entry and exported pack stays byte-identical.
+
+    ``abi_fingerprint`` is the bucket fingerprint plus the tenant-count
+    sub-bucket tag (``:tK`` for K > 1 -- see
+    :func:`parallel.compile_pool.tenant_tag`); ``program_spec`` and
+    ``operands()`` mirror :class:`AbiLowered`'s interface so the batch
+    layer's ``_prog_spec``/``_prog_args`` seam handles both."""
+
+    def __init__(self, lows, k_bucket: int | None = None):
+        lows = tuple(lows)
+        if not lows:
+            raise AbiBucketError([("pack", "cannot pack zero tenants")])
+        issues = []
+        for i, low in enumerate(lows):
+            if not isinstance(low, AbiLowered):
+                issues.append((f"tenant {i}",
+                               f"not an AbiLowered (got "
+                               f"{type(low).__name__}); lower each "
+                               f"mechanism with lower_spec/maybe_lower "
+                               f"first"))
+            elif low.program_spec is not lows[0].program_spec:
+                issues.append((f"tenant {i}",
+                               f"bucket {low.abi_fingerprint} != tenant "
+                               f"0's {lows[0].abi_fingerprint}; only "
+                               f"same-bucket mechanisms can share a "
+                               f"packed program"))
+        if issues:
+            raise AbiBucketError(issues)
+        self.tenants = lows
+        self.k = len(lows)
+        kb = _pow2_at_least(self.k if k_bucket is None else k_bucket)
+        if kb < self.k:
+            raise AbiBucketError([
+                ("pack", f"k_bucket {kb} < {self.k} tenants")])
+        self.k_bucket = kb
+        self.static = lows[0].static
+        self.program_spec = lows[0].program_spec
+        from ..parallel.compile_pool import tenant_tag
+        self.abi_fingerprint = (self.program_spec.abi_fingerprint
+                                + tenant_tag(kb))
+        # Ghost tenants replicate tenant 0 up to the pow2 bucket.
+        self._order = tuple(range(self.k)) + (0,) * (kb - self.k)
+        self._np_operands = {
+            key: np.stack([lows[i]._np_operands[key]
+                           for i in self._order])
+            for key in lows[0]._np_operands}
+        self._device_operands = None
+
+    @property
+    def occupancy(self) -> float:
+        """Real tenants over the pow2 tenant bucket (ghosts excluded)."""
+        return self.k / self.k_bucket
+
+    def operands(self) -> dict:
+        """The stacked traced operand pytree: every leaf of the solo
+        operand dict with a leading ``[k_bucket]`` tenant axis."""
+        if self._device_operands is None:
+            import jax.numpy as jnp
+            self._device_operands = {
+                k: jnp.asarray(v) for k, v in self._np_operands.items()}
+        return self._device_operands
+
+    def stack_tenants(self, per_tenant):
+        """Stack K per-tenant pytrees (pre-padded to the bucket shape)
+        along the tenant axis, replicating tenant 0 into the ghost
+        slots. ``None`` passes through (an absent optional input is
+        absent for every tenant)."""
+        per_tenant = list(per_tenant)
+        if len(per_tenant) != self.k:
+            raise ValueError(f"expected {self.k} per-tenant values, "
+                             f"got {len(per_tenant)}")
+        if all(v is None for v in per_tenant):
+            return None
+        if any(v is None for v in per_tenant):
+            raise ValueError("per-tenant inputs must be all-present or "
+                             "all-None across the pack")
+        import jax
+        import jax.numpy as jnp
+        full = [per_tenant[i] for i in self._order]
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(x) for x in leaves]),
+            *full)
+
+    def pad_conditions(self, conds_list):
+        """Per-tenant boundary padding then tenant stacking:
+        ``[K x Conditions(lanes, real dims)]`` -> one stacked
+        ``Conditions`` pytree of ``[k_bucket, lanes, bucket dims]``
+        leaves."""
+        return self.stack_tenants(
+            [low.pad_conditions(c)
+             for low, c in zip(self.tenants, conds_list)])
+
+    def pad_tof_mask(self, masks):
+        if masks is None or all(m is None for m in masks):
+            return None
+        return self.stack_tenants(
+            [low.pad_tof_mask(m)
+             for low, m in zip(self.tenants, masks)])
+
+    def pad_x0(self, x0s):
+        if x0s is None or all(x is None for x in x0s):
+            return None
+        return self.stack_tenants(
+            [low.pad_x0(x) for low, x in zip(self.tenants, x0s)])
+
+    def unpad_y(self, y, tenant: int):
+        """Strip pad species from tenant ``tenant``'s composition axis."""
+        return self.tenants[tenant].unpad_y(y)
+
+
+def pack_lowered(lows, k_bucket: int | None = None) -> PackedLowered:
+    """Pack K lowered mechanisms of ONE ABI bucket into a
+    :class:`PackedLowered` (tenant axis padded to a power of two with
+    ghost replicas of tenant 0). Raises :class:`AbiBucketError` when
+    the tenants span buckets or precision tiers -- the request
+    coalescer (parallel/dispatch.py) groups by fingerprint precisely so
+    this can never fire on its watch."""
+    return PackedLowered(lows, k_bucket=k_bucket)
+
+
+# ----------------------------------------------------------------------
 # gating
 
 _LOWER_CACHE: dict = {}

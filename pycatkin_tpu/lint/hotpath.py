@@ -37,6 +37,13 @@ HOT_FUNCTIONS = frozenset({
     "_fused_sweep", "_assemble_clean", "_stability_tier2",
     "_rescue", "_quarantine_mask", "stability_mask",
     "continuation_sweep",
+    # Packed multi-tenant batching: the packed dispatch + the shared
+    # post-bundle triage. A stray materialization in _fused_decide
+    # would multiply by K tenants, so it is held to the same
+    # discipline (the packed clean path spends exactly ONE counted
+    # sync total, regardless of K -- test_sync_budget.py pins it).
+    "packed_sweep_steady_state", "_packed_fused_sweep",
+    "_split_fused_out", "_fused_decide",
 })
 
 # file (posix path relative to the repo root) -> hot function names.

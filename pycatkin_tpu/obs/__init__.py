@@ -28,8 +28,9 @@ from .costs import (CostLedger, device_peak,  # noqa: F401
                     flops_per_iteration, harvest_cost, ledger_snapshot)
 from .export import (attribute_outlier, chrome_trace,  # noqa: F401
                      format_lane_heatmap, format_span_table,
-                     format_worker_timeline, lane_summary, load_trace,
-                     span_summary, span_tree, top_spans, worker_summary,
+                     format_tenant_heatmaps, format_worker_timeline,
+                     lane_summary, load_trace, span_summary, span_tree,
+                     tenant_lane_summaries, top_spans, worker_summary,
                      write_chrome_trace)
 from .history import (baseline, extract_metrics,  # noqa: F401
                       flag_regressions, load_history)
@@ -46,6 +47,7 @@ __all__ = [
     "root_trace", "chrome_trace", "write_chrome_trace", "load_trace",
     "span_tree", "span_summary", "top_spans", "format_span_table",
     "attribute_outlier", "lane_summary", "format_lane_heatmap",
+    "tenant_lane_summaries", "format_tenant_heatmaps",
     "worker_summary", "format_worker_timeline",
     "run_manifest", "counter", "gauge",
     "histogram", "default_registry", "metrics_snapshot",

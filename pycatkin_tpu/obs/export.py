@@ -338,6 +338,33 @@ def format_lane_heatmap(lane_telemetry, width: int = 64) -> str:
     return "\n".join(lines)
 
 
+def tenant_lane_summaries(tenant_telemetry) -> list:
+    """Per-tenant :func:`lane_summary` for a packed multi-tenant
+    sweep's stacked telemetry (``[K, lanes, 5]`` numpy array, or a list
+    of per-tenant ``[lanes, 5]`` arrays -- the coalescer records the
+    latter, one per tenant, real tenants only)."""
+    return [lane_summary(t) for t in (tenant_telemetry or [])]
+
+
+def format_tenant_heatmaps(tenant_telemetry, width: int = 64) -> str:
+    """The lane heatmap grouped by tenant: one
+    :func:`format_lane_heatmap` block per tenant of a packed sweep,
+    headed by the tenant index and its quarantine-ish tail counts so a
+    poisoned tenant is visually separable from its clean co-tenants."""
+    tenants = list(tenant_telemetry or [])
+    if not tenants:
+        return "no per-tenant lane telemetry"
+    lines = [f"packed sweep: {len(tenants)} tenant(s)"]
+    for k, tel in enumerate(tenants):
+        s = lane_summary(tel)
+        rescued = sum(v for name, v in (s.get("strategies") or {}).items()
+                      if name not in ("clean", STRATEGY_NAMES[0]))
+        lines.append(f"-- tenant {k}: {s.get('lanes', 0)} lanes, "
+                     f"{rescued} non-clean --")
+        lines.append(format_lane_heatmap(tel, width=width))
+    return "\n".join(lines)
+
+
 # -- elastic worker lifecycle (events = the kind="worker" records the
 #    scheduler appends to events.jsonl / report["events"]) -------------
 
@@ -349,14 +376,31 @@ def worker_summary(events) -> dict:
     evs = [e for e in (events or []) if e.get("kind") == "worker"]
     actions: dict[str, int] = {}
     restarts: dict[str, int] = {}
+    packs = 0
+    pack_tenants = 0
+    tenant_quarantined: dict[str, int] = {}
     for e in evs:
         act = str(e.get("action", "?"))
         actions[act] = actions.get(act, 0) + 1
         if act == "restart":
             lbl = str(e.get("label", "?"))
             restarts[lbl] = restarts.get(lbl, 0) + 1
-    return {"n_events": len(evs), "actions": actions,
-            "restarts": restarts}
+        if act == "pack-flush":
+            packs += 1
+            tq = e.get("tenant_quarantined") or []
+            pack_tenants += int(e.get("tenants", len(tq)) or 0)
+            for k, n in enumerate(tq):
+                if n:
+                    key = f"{e.get('label', '?')}[{k}]"
+                    tenant_quarantined[key] = (
+                        tenant_quarantined.get(key, 0) + int(n))
+    out = {"n_events": len(evs), "actions": actions,
+           "restarts": restarts}
+    if packs:
+        out["packs"] = packs
+        out["pack_tenants"] = pack_tenants
+        out["tenant_quarantined"] = tenant_quarantined
+    return out
 
 
 def format_worker_timeline(events) -> str:
@@ -387,7 +431,8 @@ def format_worker_timeline(events) -> str:
         for key in ("pid", "incarnation", "returncode", "exit_kind",
                     "kills", "cause", "owner", "stolen_from", "mid",
                     "children", "attempt", "delay_s", "restarts",
-                    "task", "n_failed", "detail", "lanes"):
+                    "task", "n_failed", "detail", "lanes", "tenants",
+                    "k_bucket", "pack_occupancy", "tenant_quarantined"):
             if key in e and e[key] is not None:
                 extra.append(f"{key}={e[key]}")
         lines.append(f"  {stamp}  {str(e.get('label', '?')):<18} "

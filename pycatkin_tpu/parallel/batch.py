@@ -74,6 +74,7 @@ def clear_program_caches():
     AOT executable registry (compile_pool)."""
     _steady_program.cache_clear()
     _fused_sweep_program.cache_clear()
+    _packed_fused_sweep_program.cache_clear()
     _rescue_program.cache_clear()
     _transient_chunk_program.cache_clear()
     _transient_finish_program.cache_clear()
@@ -181,15 +182,19 @@ def _prog_spec(spec):
     the interned bucket object for an ABI-lowered spec (shared by every
     mechanism in the bucket -- the whole point), the ModelSpec itself
     otherwise."""
-    return spec.program_spec if isinstance(spec, _abi.AbiLowered) else spec
+    if isinstance(spec, (_abi.AbiLowered, _abi.PackedLowered)):
+        return spec.program_spec
+    return spec
 
 
 def _prog_args(spec, args):
     """Argument tuple a program is actually dispatched with: ABI
     programs take the mechanism operand pytree as their leading traced
-    argument. Prewarm's direct program_key()/lower() paths and the
-    in-band dispatch MUST both go through this, or their keys drift."""
-    if isinstance(spec, _abi.AbiLowered):
+    argument (a :class:`frontend.abi.PackedLowered` prepends the
+    tenant-stacked pytree the same way). Prewarm's direct
+    program_key()/lower() paths and the in-band dispatch MUST both go
+    through this, or their keys drift."""
+    if isinstance(spec, (_abi.AbiLowered, _abi.PackedLowered)):
         return (spec.operands(),) + tuple(args)
     return tuple(args)
 
@@ -738,6 +743,127 @@ def _stability_screen_program(spec: ModelSpec, pos_tol: float,
     return jax.jit(batched)
 
 
+def _abi_fused_body(spec: "_abi.AbiProgramSpec", opts: SolverOptions,
+                    pos_tol: float, backend: str, has_tof: bool,
+                    check_stability: bool, tier: str):
+    """The traceable body of the ABI fused sweep program --
+    ``program(ops, conds, keys, x0, *tail)`` over one mechanism's
+    operand pytree and one ``[lanes]`` batch. Shared VERBATIM by the
+    solo jit (:func:`_fused_sweep_program`'s ABI branch) and the
+    tenant-vmapped packed jit (:func:`_packed_fused_sweep_program`), so
+    a packed tenant runs the exact same trace as its solo sweep -- the
+    bit-identity contract of tests/test_packed_batching.py hangs on
+    this function having exactly one definition."""
+    tier_code = _precision.TIER_CODES[tier]
+    from ..solvers.newton import (effective_unit_roundoff,
+                                  lane_finite_mask,
+                                  lyapunov_certified_stable,
+                                  packed_lane_telemetry,
+                                  packed_sweep_diagnostics,
+                                  stability_tolerance_from_scale)
+    eps_eff = (effective_unit_roundoff(jnp.float64, backend)
+               if check_stability else None)
+
+    def program(ops, conds, keys, x0, *tail_args):
+        tspec = spec.bind(ops)
+        dyn = tspec.dynamic_indices
+
+        def solve_one(cond, key, x0):
+            return engine.steady_state(tspec, cond, x0=x0, key=key,
+                                       opts=opts, strategy="ptc",
+                                       tier=tier)
+
+        res = jax.vmap(solve_one)(conds, keys, x0)
+        finite_l = lane_finite_mask(res.x, res.residual)
+        succ_raw = jnp.asarray(res.success)
+        quar = succ_raw & ~finite_l
+        succ0 = succ_raw & finite_l
+        res = res._replace(success=succ0)
+        outs = [res, quar]
+        amb = demoted = None
+        ok_spec = succ0
+        if check_stability:
+            Q = tspec.lyap_q
+            lyap_ok = tspec.lyap_ok > 0
+
+            def screen_one(cond, y):
+                J = engine.steady_jacobian(tspec, cond, y[dyn])
+                absJ = jnp.abs(J)
+                diag = jnp.diag(J)
+                offrow = jnp.sum(absJ, axis=1) - jnp.abs(diag)
+                offcol = jnp.sum(absJ, axis=0) - jnp.abs(diag)
+                bound = jnp.minimum(jnp.max(diag + offrow),
+                                    jnp.max(diag + offcol))
+                scale = jnp.max(absJ)
+                finite = jnp.all(jnp.isfinite(J))
+                tol = stability_tolerance_from_scale(scale, pos_tol)
+                cert = finite & (bound <= tol)
+                cert = cert | (finite & lyap_ok
+                               & lyapunov_certified_stable(
+                                   J, Q, tol, eps_eff=eps_eff))
+                return cert, finite
+
+            cert_raw, finite = jax.vmap(screen_one)(conds, res.x)
+            good = finite & succ0
+            cert = good & cert_raw
+            amb = good & ~cert
+            demoted = succ0 & ~cert
+            ok_spec = succ0 & cert
+            outs += [cert, amb]
+        n_neg = None
+        if has_tof:
+            mask = tail_args[0]
+            tofs = jax.vmap(
+                lambda c, y: engine.tof(tspec, c, y, mask))(conds,
+                                                            res.x)
+            act = engine.activity_from_tof(
+                tofs, jax.tree_util.tree_leaves(conds.T)[0])
+            neg = jnp.isfinite(tofs) & (tofs < 0.0)
+            lane_ok = ok_spec & jnp.isfinite(tofs)
+            n_neg = jnp.sum(lane_ok & (tofs < 0.0))
+            outs += [tofs, act, neg]
+        # Packed per-lane telemetry (iterations/chords/residual
+        # decade/strategy/tier) rides as the second-to-last output,
+        # so the clean tail syncs it in the SAME batched device_get
+        # as the diagnostics bundle -- sync count unchanged. The
+        # tier column stamps lanes the first pass ACCEPTED (the
+        # rescue ladder that rewrites the rest is always f64).
+        outs.append(packed_lane_telemetry(
+            res.iterations, res.chords, res.residual,
+            tier=jnp.where(succ0, jnp.int32(tier_code),
+                           jnp.int32(0))))
+        outs.append(packed_sweep_diagnostics(succ0, quar, amb,
+                                             demoted, n_neg))
+        return tuple(outs)
+
+    return program
+
+
+@lru_cache(maxsize=16)
+def _packed_fused_sweep_program(spec: "_abi.AbiProgramSpec",
+                                opts: SolverOptions, pos_tol: float,
+                                backend: str, has_tof: bool,
+                                check_stability: bool,
+                                tier: str = "f64"):
+    """The multi-tenant fused sweep: :func:`_abi_fused_body` vmapped
+    over a new leading *tenant* axis, so K same-bucket mechanisms'
+    sweeps are ONE device dispatch producing the solo output tuple with
+    every element stacked ``[k_bucket, ...]`` (the diagnostics bundle
+    becomes ``[k_bucket, 5]`` -- per-tenant escalation verdicts from
+    one sync).
+
+    The tenant count is deliberately NOT a cache key here: one jitted
+    callable serves every occupancy, and XLA specializes per stacked
+    shape exactly as it does per lane count. Registry/AOT keys still
+    separate occupancies through the ``:tK`` kind tag + the argument
+    shape signature (:func:`compile_pool.tenant_tag`). Only the PRNG
+    keys are donated, mirroring the solo program."""
+    body = _abi_fused_body(spec, opts, pos_tol, backend, has_tof,
+                           check_stability, tier)
+    return jax.jit(jax.vmap(body),
+                   donate_argnums=_donate_argnums((2,)))
+
+
 @lru_cache(maxsize=16)
 def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                          pos_tol: float, backend: str, has_tof: bool,
@@ -792,81 +918,8 @@ def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
         # traced lyap_q/lyap_ok operands (see
         # _stability_screen_program's ABI branch for the abstention
         # semantics).
-        eps_eff = (effective_unit_roundoff(jnp.float64, backend)
-                   if check_stability else None)
-
-        def program(ops, conds, keys, x0, *tail_args):
-            tspec = spec.bind(ops)
-            dyn = tspec.dynamic_indices
-
-            def solve_one(cond, key, x0):
-                return engine.steady_state(tspec, cond, x0=x0, key=key,
-                                           opts=opts, strategy="ptc",
-                                           tier=tier)
-
-            res = jax.vmap(solve_one)(conds, keys, x0)
-            finite_l = lane_finite_mask(res.x, res.residual)
-            succ_raw = jnp.asarray(res.success)
-            quar = succ_raw & ~finite_l
-            succ0 = succ_raw & finite_l
-            res = res._replace(success=succ0)
-            outs = [res, quar]
-            amb = demoted = None
-            ok_spec = succ0
-            if check_stability:
-                Q = tspec.lyap_q
-                lyap_ok = tspec.lyap_ok > 0
-
-                def screen_one(cond, y):
-                    J = engine.steady_jacobian(tspec, cond, y[dyn])
-                    absJ = jnp.abs(J)
-                    diag = jnp.diag(J)
-                    offrow = jnp.sum(absJ, axis=1) - jnp.abs(diag)
-                    offcol = jnp.sum(absJ, axis=0) - jnp.abs(diag)
-                    bound = jnp.minimum(jnp.max(diag + offrow),
-                                        jnp.max(diag + offcol))
-                    scale = jnp.max(absJ)
-                    finite = jnp.all(jnp.isfinite(J))
-                    tol = stability_tolerance_from_scale(scale, pos_tol)
-                    cert = finite & (bound <= tol)
-                    cert = cert | (finite & lyap_ok
-                                   & lyapunov_certified_stable(
-                                       J, Q, tol, eps_eff=eps_eff))
-                    return cert, finite
-
-                cert_raw, finite = jax.vmap(screen_one)(conds, res.x)
-                good = finite & succ0
-                cert = good & cert_raw
-                amb = good & ~cert
-                demoted = succ0 & ~cert
-                ok_spec = succ0 & cert
-                outs += [cert, amb]
-            n_neg = None
-            if has_tof:
-                mask = tail_args[0]
-                tofs = jax.vmap(
-                    lambda c, y: engine.tof(tspec, c, y, mask))(conds,
-                                                                res.x)
-                act = engine.activity_from_tof(
-                    tofs, jax.tree_util.tree_leaves(conds.T)[0])
-                neg = jnp.isfinite(tofs) & (tofs < 0.0)
-                lane_ok = ok_spec & jnp.isfinite(tofs)
-                n_neg = jnp.sum(lane_ok & (tofs < 0.0))
-                outs += [tofs, act, neg]
-            # Packed per-lane telemetry (iterations/chords/residual
-            # decade/strategy/tier) rides as the second-to-last output,
-            # so the clean tail syncs it in the SAME batched device_get
-            # as the diagnostics bundle -- sync count unchanged. The
-            # tier column stamps lanes the first pass ACCEPTED (the
-            # rescue ladder that rewrites the rest is always f64).
-            outs.append(packed_lane_telemetry(
-                res.iterations, res.chords, res.residual,
-                tier=jnp.where(succ0, jnp.int32(tier_code),
-                               jnp.int32(0))))
-            outs.append(packed_sweep_diagnostics(succ0, quar, amb,
-                                                 demoted, n_neg))
-            return tuple(outs)
-
+        program = _abi_fused_body(spec, opts, pos_tol, backend, has_tof,
+                                  check_stability, tier)
         kw = {"donate_argnums": _donate_argnums((2,))}
         if out_sharding is not None:
             # 3 = res + quar + the [lanes, 5] telemetry pack.
@@ -1536,6 +1589,16 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     with span("fused sweep"):
         out = call_with_backend_retry(run_fused,
                                       label="batched steady solve")
+    parts = _split_fused_out(out, check_stability, has_tof)
+    return _fused_decide(spec, conds, tof_mask, opts, check_stability,
+                         pos_jac_tol, mesh, tier, backend, parts)
+
+
+def _split_fused_out(out, check_stability: bool, has_tof: bool):
+    """Name the fused program's positional output tuple (after the tail
+    bundle sync replaced the last two slots with host arrays):
+    ``(res, quar, cert, amb, tofs, act, neg, lane_tel, bundle)`` with
+    ``None`` for absent optional slots."""
     res, quar = out[0], out[1]
     pos = 2
     cert = amb = None
@@ -1546,9 +1609,24 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     if has_tof:
         tofs, act, neg = out[pos], out[pos + 1], out[pos + 2]
         pos += 3
-    lane_tel = out[pos]
-    pos += 1
-    nf, nq, n_amb, n_dem, n_neg = (int(c) for c in out[pos])
+    return (res, quar, cert, amb, tofs, act, neg, out[pos],
+            out[pos + 1])
+
+
+def _fused_decide(spec: ModelSpec, conds: Conditions, tof_mask,
+                  opts: SolverOptions, check_stability: bool,
+                  pos_jac_tol: float, mesh: Optional[Mesh], tier: str,
+                  backend: str, parts):
+    """The fused sweep's post-bundle outcome triage (see
+    :func:`_fused_sweep`'s tier docstring): clean assembly, the
+    tier-2-only escalation, or the exact legacy tail. Factored out of
+    :func:`_fused_sweep` so the packed multi-tenant path runs the SAME
+    decision per tenant over its slice of the stacked outputs -- a
+    poisoned tenant escalates alone, bit-for-bit like its solo run,
+    while clean co-tenants assemble with zero further syncs."""
+    res, quar, cert, amb, tofs, act, neg, lane_tel, bundle = parts
+    has_tof = tof_mask is not None
+    nf, nq, n_amb, n_dem, n_neg = (int(c) for c in bundle)
 
     # Escalation instrument from the already-materialized bundle
     # counts: host ints only, no extra syncs or dispatches on any tier.
@@ -1605,6 +1683,309 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
     return _finish_sweep(spec, conds, res_raw, opts, tof_mask,
                          check_stability, pos_jac_tol, backend=backend,
                          mesh=mesh, tier=tier)
+
+
+def _packed_kind(opts: SolverOptions, pos_tol: float, backend: str,
+                 has_tof: bool, check_stability: bool, tier: str,
+                 k_bucket: int) -> str:
+    """Registry/cache kind string for the packed multi-tenant fused
+    sweep: the solo fused kind plus the tenant-count pow2 sub-bucket
+    tag, composed LAST (after the tier tag) so a ``k_bucket`` of 1
+    reproduces the solo kind byte-for-byte."""
+    return (_fused_kind(opts, pos_tol, backend, has_tof,
+                        check_stability, None, tier=tier)
+            + compile_pool.tenant_tag(k_bucket))
+
+
+def _packed_fused_sweep(pack, conds_list, mask_list, x0_list,
+                        opts: SolverOptions, check_stability: bool,
+                        pos_jac_tol: float):
+    """One packed dispatch for K same-bucket tenants, then per-tenant
+    outcome triage. ``conds_list``/``mask_list``/``x0_list`` are the
+    per-REAL-tenant *padded* inputs (exactly what each tenant's solo
+    ABI sweep would dispatch); ghost-tenant replication happens in
+    :meth:`PackedLowered.stack_tenants`.
+
+    The clean path spends exactly ONE counted host sync regardless of
+    K: the stacked telemetry pack + ``[k_bucket, 5]`` diagnostics
+    bundle ride a single batched ``host_sync``, and each clean tenant's
+    :func:`_fused_decide` assembles from device slices without another
+    pull. A dirty tenant escalates through its own solo-identical
+    decision (tier-2 masks / legacy tail) without touching its
+    co-tenants' results."""
+    kb = pack.k_bucket
+    backend = _resolve_backend()
+    tier = _precision.active_tier()
+    fast = _fast_pass_opts(opts)
+    has_tof = mask_list is not None
+    n_lanes = jax.tree_util.tree_leaves(conds_list[0])[0].shape[0]
+    conds_st = pack.stack_tenants(conds_list)
+    x0_st = pack.stack_tenants(x0_list) if x0_list is not None else None
+    tail = ((pack.stack_tenants([jnp.asarray(m) for m in mask_list]),)
+            if has_tof else ())
+    prog = _packed_fused_sweep_program(pack.program_spec, fast,
+                                       pos_jac_tol, backend, has_tof,
+                                       check_stability, tier=tier)
+    kind = _packed_kind(fast, pos_jac_tol, backend, has_tof,
+                        check_stability, tier, kb)
+
+    def run_packed():
+        # Every tenant gets the SAME per-lane key array its solo sweep
+        # would build (bit-identity); rebuilt per retry because the
+        # program donates the keys.
+        keys = jnp.broadcast_to(
+            jax.random.split(jax.random.PRNGKey(0), n_lanes),
+            (kb, n_lanes, 2))
+        args = (conds_st, keys, x0_st) + tail
+        fkey = compile_pool.program_key(kind, _prog_args(pack, args))
+        _costs.record(fkey, kind=kind,
+                      label=f"packed fused sweep @{n_lanes}"
+                            f" x{pack.k}/{kb}")
+        out = _registered_call(pack, kind, prog, args)
+        t0 = _time_mod.perf_counter()
+        tel, bundle = host_sync((out[-2], out[-1]),
+                                "packed fused tail bundle")
+        _costs.note_dispatch(fkey, _time_mod.perf_counter() - t0,
+                             count=0)
+        return out[:-2] + (tel, bundle)
+
+    with span("packed fused sweep", tenants=pack.k, k_bucket=kb,
+              lanes=n_lanes):
+        out = call_with_backend_retry(run_packed,
+                                      label="packed batched steady "
+                                            "solve")
+    res, quar, cert, amb, tofs, act, neg, lane_tel, bundle = \
+        _split_fused_out(out, check_stability, has_tof)
+
+    def _slice(tree, k):
+        if tree is None:
+            return None
+        return jax.tree_util.tree_map(lambda a: a[k], tree)
+
+    results = []
+    for k, low in enumerate(pack.tenants):
+        parts_k = (_slice(res, k), quar[k], _slice(cert, k),
+                   _slice(amb, k), _slice(tofs, k), _slice(act, k),
+                   _slice(neg, k), lane_tel[k], bundle[k])
+        results.append(_fused_decide(
+            low, conds_list[k], mask_list[k] if has_tof else None,
+            opts, check_stability, pos_jac_tol, None, tier, backend,
+            parts_k))
+    return results
+
+
+def packed_sweep_steady_state(specs, conds, tof_mask=None, x0=None,
+                              opts: SolverOptions = SolverOptions(),
+                              check_stability: bool = False,
+                              pos_jac_tol: float = 1e-2) -> list:
+    """Multi-tenant :func:`sweep_steady_state`: K mechanisms that lower
+    into ONE ABI bucket run as one packed device dispatch (one host
+    sync, one AOT executable, zero marginal compiles in a warm bucket)
+    and return a LIST of per-tenant result dicts, each bitwise
+    identical to what that mechanism's solo ``sweep_steady_state`` call
+    would return.
+
+    ``conds`` / ``tof_mask`` / ``x0`` may each be a single value
+    (shared by every tenant) or a per-tenant sequence; lane counts must
+    match across tenants (the request coalescer,
+    :class:`parallel.dispatch.SweepCoalescer`, groups by
+    ``(abi_fingerprint, lane count)`` so its packs satisfy this by
+    construction).
+
+    Degradations that fall back to per-tenant solo sweeps (results
+    unchanged, the packing speedup forfeited, a ``degradation`` event
+    recorded): a single tenant; the ABI gate off or a mechanism that
+    fits no bucket; the fused tail disabled (``PYCATKIN_FUSED_SWEEP=0``
+    or an active fault plan -- fault containment stays per-site).
+    Tenants that lower into DIFFERENT buckets raise
+    :class:`frontend.abi.AbiBucketError` instead: silently serializing
+    a cross-bucket pack would hide the grouping bug upstream."""
+    specs = list(specs)
+    k = len(specs)
+    if k == 0:
+        return []
+
+    def _per_tenant(v, name):
+        vs = (list(v) if isinstance(v, (list, tuple)) else [v] * k)
+        if len(vs) != k:
+            raise ValueError(f"{name}: {len(vs)} entries for {k} "
+                            f"tenants")
+        return vs
+
+    conds_list = _per_tenant(conds, "conds")
+    masks = _per_tenant(tof_mask, "tof_mask")
+    x0s = _per_tenant(x0, "x0")
+
+    def _solo():
+        return [sweep_steady_state(s, c, tof_mask=m, x0=x, opts=opts,
+                                   check_stability=check_stability,
+                                   pos_jac_tol=pos_jac_tol)
+                for s, c, m, x in zip(specs, conds_list, masks, x0s)]
+
+    if k == 1:
+        # Degenerate pack: the solo path, so program keys/caches stay
+        # byte-identical to the pre-packing world (:tK contract).
+        return _solo()
+    lows = [s if isinstance(s, _abi.AbiLowered) else _abi.maybe_lower(s)
+            for s in specs]
+    if any(low is None for low in lows) or not _fused_enabled():
+        record_event("degradation", label="packed:solo-fallback",
+                     detail="ABI lowering or the fused tail is "
+                            "unavailable; running tenants as solo "
+                            "sweeps", tenants=k)
+        _metrics.counter(
+            "pycatkin_packed_solo_fallbacks_total",
+            "packed sweep requests degraded to per-tenant solo "
+            "sweeps").inc()
+        return _solo()
+    pack = _abi.pack_lowered(lows)
+
+    lanes = [jax.tree_util.tree_leaves(c)[0].shape[0]
+             for c in conds_list]
+    if len(set(lanes)) != 1:
+        raise ValueError(f"packed tenants must share a lane count, "
+                         f"got {lanes}")
+    _metrics.counter(
+        "pycatkin_packed_sweeps_total",
+        "packed multi-tenant dispatches per tenant sub-bucket").inc(
+            bucket=pack.abi_fingerprint)
+    _metrics.histogram(
+        "pycatkin_pack_occupancy",
+        "real tenants over the pow2 tenant bucket",
+        buckets=(0.25, 0.5, 0.75, 1.0)).observe(pack.occupancy)
+    for low in pack.tenants:
+        _metrics.counter(
+            "pycatkin_abi_bucket_sweeps_total",
+            "sweeps dispatched per ABI shape bucket").inc(
+                bucket=low.abi_fingerprint)
+    _metrics.counter("pycatkin_lanes_solved_total",
+                     "lanes entering sweep_steady_state").inc(
+                         k * lanes[0])
+
+    conds_pad = [low.pad_conditions(c)
+                 for low, c in zip(lows, conds_list)]
+    has_tof = any(m is not None for m in masks)
+    if has_tof and not all(m is not None for m in masks):
+        raise ValueError("tof_mask must be given for every tenant or "
+                         "none (the coalescer groups by TOF-ness)")
+    masks_pad = ([low.pad_tof_mask(m) for low, m in zip(lows, masks)]
+                 if has_tof else None)
+    has_x0 = any(x is not None for x in x0s)
+    if has_x0 and not all(x is not None for x in x0s):
+        raise ValueError("x0 must be given for every tenant or none")
+    x0_pad = ([low.pad_x0(x) for low, x in zip(lows, x0s)]
+              if has_x0 else None)
+
+    _t_sweep = _time_mod.perf_counter()
+    try:
+        outs = _packed_fused_sweep(pack, conds_pad, masks_pad, x0_pad,
+                                   opts, check_stability, pos_jac_tol)
+    finally:
+        _metrics.histogram(
+            "pycatkin_packed_sweep_wall_seconds",
+            "packed multi-tenant sweep wall time").observe(
+                _time_mod.perf_counter() - _t_sweep)
+    for i, (low, out) in enumerate(zip(lows, outs)):
+        out["y"] = low.unpad_y(jnp.asarray(out["y"]))
+    return outs
+
+
+def prewarm_packed_sweep_programs(specs, conds, tof_mask=None,
+                                  opts: SolverOptions = SolverOptions(),
+                                  check_stability: bool = False,
+                                  pos_jac_tol: float = 1e-2,
+                                  cache=None):
+    """Load-or-compile the ONE packed fused executable a
+    :func:`packed_sweep_steady_state` call over these tenants would
+    dispatch (registry + AOT cache, no execution). The per-bucket
+    rescue/tier-2 programs are solo-shaped and come from the ordinary
+    :func:`prewarm_sweep_programs` -- a dirty tenant escalates through
+    the same bucket zoo its solo run uses.
+
+    Returns :class:`PrewarmStats`; a SECOND pack of fresh mechanisms in
+    a warm ``(bucket, k_bucket, lanes)`` cell must report
+    ``stats.compiled == 0`` -- the zero-marginal-compile gate bench.py
+    and the packed CI lane assert."""
+    specs = list(specs)
+    k = len(specs)
+    stats = PrewarmStats(0)
+    stats.compiled = stats.loaded = stats.executed = 0
+    stats.cache_writes = 0
+    stats.cache = {}
+    if k <= 1:
+        return stats              # solo path owns the K=1 programs
+    lows = [s if isinstance(s, _abi.AbiLowered) else _abi.maybe_lower(s)
+            for s in specs]
+    if any(low is None for low in lows) or not _fused_enabled():
+        return stats
+    pack = _abi.pack_lowered(lows)
+    if cache is None:
+        cache = compile_pool.AOTCache(
+            fingerprint=compile_pool.spec_fingerprint(pack))
+    elif cache is False:
+        cache = compile_pool.AOTCache(root="off")
+
+    def _per_tenant(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * k
+
+    conds_list = _per_tenant(conds)
+    masks = _per_tenant(tof_mask)
+    has_tof = masks[0] is not None
+    kb = pack.k_bucket
+    backend = _resolve_backend()
+    tier = _precision.active_tier()
+    fast = _fast_pass_opts(opts)
+    conds_st = pack.stack_tenants(
+        [low.pad_conditions(c) for low, c in zip(lows, conds_list)])
+    tail = ((pack.stack_tenants(
+        [jnp.asarray(low.pad_tof_mask(m))
+         for low, m in zip(lows, masks)]),) if has_tof else ())
+    n_lanes = jax.tree_util.tree_leaves(conds_list[0])[0].shape[0]
+    keys = jnp.broadcast_to(
+        jax.random.split(jax.random.PRNGKey(0), n_lanes),
+        (kb, n_lanes, 2))
+    prog = _packed_fused_sweep_program(pack.program_spec, fast,
+                                       pos_jac_tol, backend, has_tof,
+                                       check_stability, tier=tier)
+    kind = _packed_kind(fast, pos_jac_tol, backend, has_tof,
+                        check_stability, tier, kb)
+    args = _prog_args(pack, (conds_st, keys, None) + tail)
+    key = compile_pool.program_key(kind, args)
+    _costs.record(key, kind=kind,
+                  label=f"packed fused sweep @{n_lanes} x{kb}")
+    stats = PrewarmStats(1)
+    stats.compiled = stats.loaded = stats.executed = 0
+    stats.cache_writes = 0
+    pspec = pack.program_spec
+    if compile_pool.lookup(pspec, key) is None:
+        exe = None
+        try:
+            exe = cache.load(key)
+        except compile_pool.CacheMismatch:
+            exe = None
+        if exe is not None:
+            compile_pool.register(pspec, key, exe)
+            stats.loaded = 1
+        else:
+            exe = call_with_backend_retry(
+                lambda: prog.lower(*args).compile(),
+                label=f"compile:packed fused sweep @{n_lanes} x{kb}")
+            _metrics.counter("pycatkin_compile_total",
+                             "fresh XLA compiles through the compile "
+                             "pool").inc()
+            cache.save(key, exe,
+                       sharding=compile_pool.args_sharding_fingerprint(
+                           args))
+            _costs.record(key, kind=kind,
+                          cost=_costs.harvest_cost(exe),
+                          source="compiled")
+            compile_pool.register(pspec, key, exe)
+            stats.compiled = 1
+    else:
+        stats.loaded = 1
+    stats.cache_writes = cache.writes
+    stats.cache = cache.stats()
+    return stats
 
 
 def _quarantine_mask(res, quarantined=None):

@@ -50,6 +50,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import re
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
@@ -81,6 +82,24 @@ def compile_workers() -> int:
         except ValueError:
             pass
     return max(1, min(8, os.cpu_count() or 1))
+
+
+def tenant_tag(k: int) -> str:
+    """Program-key / fingerprint suffix for the tenant-count pow2
+    sub-bucket of a packed multi-tenant program (``:tK``). ``k <= 1``
+    returns the empty string so every pre-packing program key, AOT
+    cache entry and exported pack stays byte-identical -- exactly the
+    :func:`precision.tier_tag` compatibility contract, and composed in
+    that order (tier tag first, tenant tag last) by the batch layer's
+    kind strings."""
+    k = int(k)
+    if k <= 1:
+        return ""
+    if k & (k - 1):
+        raise ValueError(
+            f"tenant sub-buckets are powers of two, got {k} (pad the "
+            f"pack with ghost tenants -- frontend.abi.PackedLowered)")
+    return f":t{k}"
 
 
 def spec_fingerprint(spec) -> str:
@@ -490,7 +509,16 @@ def abi_entry_fields(fingerprint: str) -> dict:
         version = int(head[len("abi-v"):])
     except ValueError:
         return {}
-    return {"abi_version": version, "abi_bucket": bucket}
+    fields = {"abi_version": version, "abi_bucket": bucket}
+    # Packed multi-tenant fingerprints carry the tenant-count pow2
+    # sub-bucket as a trailing ``:tK`` (frontend.abi.PackedLowered);
+    # split it out so pack audits can tell a 4-tenant executable from
+    # the solo one without string surgery.
+    m = re.search(r":t(\d+)$", bucket)
+    if m:
+        fields["abi_bucket"] = bucket[:m.start()]
+        fields["abi_tenants"] = int(m.group(1))
+    return fields
 
 
 def _entry_meta(path: str) -> dict:

@@ -50,6 +50,208 @@ def load_conditions(path: str):
         return Conditions(**{f: z[f] for f in Conditions._fields})
 
 
+# Request-coalescer policy knobs (documented in the PCL006 env
+# registry, docs/index.md; semantics in docs/perf_packed_batching.md).
+PACKED_MAX_OCCUPANCY_ENV = "PYCATKIN_PACKED_MAX_OCCUPANCY"
+PACKED_MAX_WAIT_ENV = "PYCATKIN_PACKED_MAX_WAIT_S"
+_PACKED_MAX_OCCUPANCY_DEFAULT = 8
+_PACKED_MAX_WAIT_DEFAULT = 0.05
+
+
+class PackedRequest:
+    """One tenant's pending sweep inside a :class:`SweepCoalescer`
+    group. ``result()`` blocks nothing: if the group has not flushed
+    yet it flushes NOW (the submitting caller asking for its answer is
+    the strongest possible "stop waiting for co-tenants" signal)."""
+
+    __slots__ = ("sim", "spec", "conds", "tof_mask", "x0", "group_key",
+                 "_coalescer", "_result", "done")
+
+    def __init__(self, coalescer, sim, spec, conds, tof_mask, x0,
+                 group_key):
+        self.sim = sim
+        self.spec = spec
+        self.conds = conds
+        self.tof_mask = tof_mask
+        self.x0 = x0
+        self.group_key = group_key
+        self._coalescer = coalescer
+        self._result = None
+        self.done = False
+
+    def result(self) -> dict:
+        if not self.done:
+            self._coalescer.flush_group(self.group_key)
+        if not self.done:
+            raise RuntimeError("packed request did not resolve after "
+                               "its group flushed (coalescer bug)")
+        return self._result
+
+
+def _default_packed_runner(sims, conds_list, masks, x0s, *,
+                           check_stability, opts, pos_jac_tol):
+    """Coalescer runner seam default: the in-process packed sweep.
+    :func:`robustness.scheduler.packed_group_runner` builds the
+    scheduler-integrated alternative."""
+    from ..solvers.newton import SolverOptions
+    from .batch import packed_sweep_steady_state
+    return packed_sweep_steady_state(
+        [getattr(s, "spec", s) for s in sims], conds_list,
+        tof_mask=masks, x0=x0s,
+        opts=SolverOptions() if opts is None else opts,
+        check_stability=check_stability, pos_jac_tol=pos_jac_tol)
+
+
+class SweepCoalescer:
+    """Continuous-batching front door for sweep-as-a-service: pending
+    sweep requests are grouped by ``(abi_fingerprint, lane count,
+    TOF-ness, x0-ness)`` -- the exact compatibility predicate of
+    :func:`frontend.abi.pack_lowered` plus the packed program's traced
+    shapes -- and each group is flushed as ONE packed multi-tenant
+    dispatch (:func:`parallel.batch.packed_sweep_steady_state`) when it
+    reaches ``max_occupancy`` tenants or its oldest request has waited
+    ``max_wait_s`` seconds (checked by :meth:`poll`), whichever comes
+    first.
+
+    Requests whose mechanism does not lower into an ABI bucket get an
+    id-unique group key, so they never co-pack and degrade to solo
+    sweeps through the K=1 path.
+
+    ``runner`` is the group-execution seam: any callable
+    ``runner(sims, conds_list, masks, x0s, *, check_stability, opts,
+    pos_jac_tol) -> list[dict]``. The default runs in-process;
+    :func:`robustness.scheduler.packed_group_runner` routes singleton
+    groups through the elastic scheduler and shares its events file.
+
+    When ``work_dir`` is given, every flush appends a ``pack-flush``
+    worker event (tenants, occupancy, lanes, per-tenant quarantine
+    counts) to ``work_dir/events.jsonl`` -- the same file the elastic
+    scheduler and ``tools/obsview.py --workers`` read."""
+
+    def __init__(self, runner=None, max_occupancy: Optional[int] = None,
+                 max_wait_s: Optional[float] = None,
+                 work_dir: Optional[str] = None,
+                 check_stability: bool = False, opts=None,
+                 pos_jac_tol: float = 1e-2):
+        if max_occupancy is None:
+            max_occupancy = int(os.environ.get(
+                PACKED_MAX_OCCUPANCY_ENV, _PACKED_MAX_OCCUPANCY_DEFAULT))
+        if max_wait_s is None:
+            max_wait_s = float(os.environ.get(
+                PACKED_MAX_WAIT_ENV, _PACKED_MAX_WAIT_DEFAULT))
+        if max_occupancy < 1:
+            raise ValueError(f"max_occupancy must be >= 1, "
+                             f"got {max_occupancy}")
+        self.runner = _default_packed_runner if runner is None else runner
+        self.max_occupancy = int(max_occupancy)
+        self.max_wait_s = float(max_wait_s)
+        self.work_dir = work_dir
+        self.check_stability = bool(check_stability)
+        self.opts = opts
+        self.pos_jac_tol = float(pos_jac_tol)
+        self._groups: dict = {}
+        self._deadlines: dict = {}
+        self.flushes = 0
+
+    def _group_key(self, sim, spec, conds, tof_mask, x0):
+        n = len(np.asarray(conds.T))
+        fp = None
+        try:
+            from ..frontend import abi as _abi
+            low = (spec if isinstance(spec, _abi.AbiLowered)
+                   else _abi.maybe_lower(spec))
+            if low is not None:
+                fp = low.abi_fingerprint
+        except Exception:
+            fp = None
+        if fp is None:
+            # Unpackable mechanism: unique key -> always a solo group.
+            return ("solo", id(sim), n)
+        return (fp, n, tof_mask is not None, x0 is not None)
+
+    def submit(self, sim, conds, tof_mask=None, x0=None) -> PackedRequest:
+        """Queue one sweep; returns its :class:`PackedRequest` handle.
+        Flushes the group immediately when it reaches
+        ``max_occupancy``."""
+        spec = getattr(sim, "spec", sim)
+        key = self._group_key(sim, spec, conds, tof_mask, x0)
+        req = PackedRequest(self, sim, spec, conds, tof_mask, x0, key)
+        group = self._groups.setdefault(key, [])
+        if not group:
+            import time as _time
+            self._deadlines[key] = _time.monotonic() + self.max_wait_s
+        group.append(req)
+        if len(group) >= self.max_occupancy:
+            self.flush_group(key)
+        return req
+
+    @property
+    def pending(self) -> int:
+        return sum(len(g) for g in self._groups.values())
+
+    def poll(self, now: Optional[float] = None) -> int:
+        """Flush every group whose oldest request exceeded
+        ``max_wait_s``; returns how many groups flushed. A serving loop
+        calls this on its idle tick."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        due = [k for k, d in self._deadlines.items() if now >= d]
+        for key in due:
+            self.flush_group(key)
+        return len(due)
+
+    def flush_all(self) -> int:
+        """Flush every pending group regardless of age/occupancy."""
+        keys = list(self._groups)
+        for key in keys:
+            self.flush_group(key)
+        return len(keys)
+
+    def flush_group(self, key) -> None:
+        reqs = self._groups.pop(key, None)
+        self._deadlines.pop(key, None)
+        if not reqs:
+            return
+        masks = [r.tof_mask for r in reqs]
+        x0s = [r.x0 for r in reqs]
+        outs = self.runner(
+            [r.sim for r in reqs], [r.conds for r in reqs], masks, x0s,
+            check_stability=self.check_stability, opts=self.opts,
+            pos_jac_tol=self.pos_jac_tol)
+        if len(outs) != len(reqs):
+            raise RuntimeError(
+                f"coalescer runner returned {len(outs)} results for "
+                f"{len(reqs)} tenants")
+        for r, o in zip(reqs, outs):
+            r._result = o
+            r.done = True
+        self.flushes += 1
+        self._emit_flush(key, reqs, outs)
+
+    def _emit_flush(self, key, reqs, outs) -> None:
+        from ..utils.profiling import record_event
+        k = len(reqs)
+        kb = 1 << max(0, (k - 1).bit_length())
+        n = len(np.asarray(reqs[0].conds.T))
+        tq = [int(np.asarray(o.get("quarantined", ())).sum())
+              for o in outs]
+        fields = {"tenants": k, "k_bucket": kb,
+                  "pack_occupancy": k / kb, "lanes": n,
+                  "tenant_quarantined": tq}
+        label = key[0] if isinstance(key, tuple) else str(key)
+        record_event("worker", action="pack-flush", label=str(label),
+                     **fields)
+        if self.work_dir:
+            import time as _time
+            from ..robustness.scheduler import EVENTS
+            from ..utils.io import append_json_line
+            os.makedirs(self.work_dir, exist_ok=True)
+            append_json_line(
+                os.path.join(self.work_dir, EVENTS),
+                {"kind": "worker", "action": "pack-flush",
+                 "label": str(label), "t": _time.time(), **fields})
+
+
 def _split_slices(n: int, k: int):
     """k contiguous, near-equal [start, stop) blocks covering range(n)."""
     bounds = np.linspace(0, n, k + 1).astype(int)
@@ -101,14 +303,50 @@ def dispatch_sweep(sim, conds, n_workers: int = 2,
     instead of failing the sweep. Extra keyword arguments
     (``chunk``, ``ttl_s``, ``max_kills``, ...) pass through;
     ``on_failure`` does not apply (degradation is per-span, built in).
+
+    ``mode="packed"`` is the multi-tenant front door: ``sim`` and
+    ``conds`` become per-tenant SEQUENCES (a single value is shared),
+    requests are coalesced by :class:`SweepCoalescer` into same-bucket
+    packs and each pack runs as ONE device dispatch
+    (:func:`parallel.batch.packed_sweep_steady_state`); returns a LIST
+    of per-tenant result dicts, each bit-identical to that tenant's
+    solo sweep. Extra keyword arguments (``max_occupancy``,
+    ``max_wait_s``, ``runner``, ``opts``, ``pos_jac_tol``) configure
+    the coalescer; ``n_workers``/``timeout``/``on_failure`` do not
+    apply (packed runs in-process unless ``runner`` says otherwise).
     """
     import tempfile
 
     from ..utils.io import save_system_json
 
-    if mode not in ("static", "elastic"):
-        raise ValueError(f"mode must be 'static' or 'elastic', "
-                         f"got {mode!r}")
+    if mode not in ("static", "elastic", "packed"):
+        raise ValueError(f"mode must be 'static', 'elastic' or "
+                         f"'packed', got {mode!r}")
+    if mode == "packed":
+        sims = list(sim) if isinstance(sim, (list, tuple)) else [sim]
+        conds_list = (list(conds) if isinstance(conds, (list, tuple))
+                      else [conds] * len(sims))
+        if len(conds_list) != len(sims):
+            raise ValueError(f"packed mode: {len(conds_list)} conds "
+                             f"for {len(sims)} sims")
+        masks = [None] * len(sims)
+        if tof_terms:
+            from .. import engine
+            masks = [engine.tof_mask_for(getattr(s, "spec", s),
+                                         list(tof_terms))
+                     for s in sims]
+        co = SweepCoalescer(work_dir=work_dir,
+                            check_stability=check_stability,
+                            **elastic_opts)
+        if worker_env:
+            raise TypeError("packed mode runs in-process; worker_env "
+                            "does not apply")
+        if aot_cache is not None:
+            os.environ.setdefault("PYCATKIN_AOT_CACHE", str(aot_cache))
+        reqs = [co.submit(s, c, tof_mask=m)
+                for s, c, m in zip(sims, conds_list, masks)]
+        co.flush_all()
+        return [r.result() for r in reqs]
     if mode == "elastic":
         from ..robustness.scheduler import run_elastic
         out, _report = run_elastic(

@@ -65,14 +65,22 @@ def tier_tag(tier: str) -> str:
     """Program-key / fingerprint suffix for ``tier``. Empty for f64 so
     every pre-tier program key, AOT cache entry and exported pack stays
     byte-identical; non-default tiers get a distinct tag so f32 and
-    f64 programs can never share an AOT entry."""
+    f64 programs can never share an AOT entry.
+
+    Tag composition order is a contract: the tier tag is appended
+    BEFORE the multi-tenant count tag
+    (:func:`parallel.compile_pool.tenant_tag`'s ``:tK``), so a packed
+    f32-polish kind ends ``...:p32:t4``. Both inverses stay valid
+    under that order -- :func:`tier_of_tag` matches ``:p32`` anywhere
+    in the kind, and the tenant parser anchors ``:tK`` at the end."""
     return "" if tier == "f64" else ":p32"
 
 
 def tier_of_tag(kind: str) -> str:
     """Inverse of :func:`tier_tag` over a program kind string: which
     tier a registered program was built for (the cost ledger keys its
-    roofline on this)."""
+    roofline on this). Substring (not suffix) match by design: packed
+    multi-tenant kinds carry a trailing ``:tK`` after the tier tag."""
     return "f32-polish" if ":p32" in kind else "f64"
 
 

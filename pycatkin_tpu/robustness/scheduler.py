@@ -903,6 +903,54 @@ def run_elastic(sim, conds, *, n_workers: int = 2,
 # Chaos drill: the standard carnage plan, packaged for `make chaos`
 # and the bench smoke gate.
 
+def packed_group_runner(work_dir: Optional[str] = None,
+                        n_workers: int = 2, tof_terms=None,
+                        **elastic_opts):
+    """Build the scheduler-integrated runner for
+    :class:`parallel.dispatch.SweepCoalescer`: coalesced groups FEED
+    the elastic tier instead of bypassing it.
+
+    - K>1 groups (same ABI bucket by construction) run as one packed
+      in-process dispatch -- multi-tenant packing IS the scheduling
+      decision for them, process isolation would forfeit the shared
+      executable.
+    - K=1 groups whose tenant is a full ``System`` run through
+      :func:`run_elastic` in a per-group subdirectory of ``work_dir``
+      (lease queue, restarts, poison bisection), with ``tof_terms``
+      forwarded (masks cannot ride to a subprocess; a K=1 group that
+      only has a mask array falls back in-process).
+
+    Both paths append their lifecycle to ``work_dir`` events
+    (run_elastic writes its own ``events.jsonl`` per group dir; the
+    coalescer's ``pack-flush`` event lands in the shared one), so
+    ``tools/obsview.py --workers`` sees packs and solo escapes in one
+    timeline."""
+
+    def run(sims, conds_list, masks, x0s, *, check_stability, opts,
+            pos_jac_tol):
+        from ..parallel.batch import packed_sweep_steady_state
+        from ..solvers.newton import SolverOptions
+        solver_opts = SolverOptions() if opts is None else opts
+        if (len(sims) == 1 and work_dir is not None
+                and hasattr(sims[0], "spec") and x0s[0] is None
+                and (masks[0] is None or tof_terms is not None)):
+            import tempfile
+            os.makedirs(work_dir, exist_ok=True)
+            group_dir = tempfile.mkdtemp(prefix="packgroup_",
+                                         dir=work_dir)
+            out, _report = run_elastic(
+                sims[0], conds_list[0], n_workers=n_workers,
+                work_dir=group_dir, tof_terms=tof_terms,
+                check_stability=check_stability, **elastic_opts)
+            return [out]
+        return packed_sweep_steady_state(
+            [getattr(s, "spec", s) for s in sims], conds_list,
+            tof_mask=masks, x0=x0s, opts=solver_opts,
+            check_stability=check_stability, pos_jac_tol=pos_jac_tol)
+
+    return run
+
+
 def chaos_drill(n_lanes: int = 8, chunk: int = 2, n_workers: int = 2,
                 verbose: bool = False) -> dict:
     """Run a small elastic sweep with one worker-crash injected via

@@ -1,0 +1,311 @@
+"""Bucket-packed multi-tenant batching (docs/perf_packed_batching.md).
+
+The acceptance contract: K mechanisms lowered into one ABI bucket run
+as ONE packed device dispatch (one counted host sync, zero marginal
+compiles in a warm bucket) and every tenant's results -- values,
+verdicts, lane telemetry -- are BITWISE identical to that tenant's
+solo ``sweep_steady_state`` run, across clean, rescue and poisoned
+corpora and both precision tiers. A poisoned tenant escalates alone;
+its co-tenants stay bit-identical to their solo runs.
+
+Key compatibility is part of the contract: ``tenant_tag(1)`` is empty
+and K=1 requests delegate to the solo path, so every pre-packing
+program key / AOT entry / cache pack stays byte-identical.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine, precision
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import compile_pool
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         clear_program_caches,
+                                         packed_sweep_steady_state,
+                                         prewarm_packed_sweep_programs,
+                                         sweep_steady_state)
+from pycatkin_tpu.parallel.dispatch import SweepCoalescer, dispatch_sweep
+from pycatkin_tpu.robustness import FaultPlan, FaultSpec, fault_scope
+from pycatkin_tpu.solvers.newton import SolverOptions
+from pycatkin_tpu.utils import profiling
+
+N_LANES = 12
+SEEDS = (0, 1, 2, 3)
+
+
+def _tenant(seed, n=N_LANES):
+    sim = synthetic_system(n_species=12, n_reactions=14, seed=seed)
+    conds = broadcast_conditions(sim.conditions(), n)
+    conds = conds._replace(T=np.linspace(430.0, 720.0, n))
+    mask = engine.tof_mask_for(sim.spec, [sim.spec.rnames[-1]])
+    return sim, conds, mask
+
+
+# Programs cache by kind string (tier/tenant tags included), so tests
+# may share compiled executables freely; clearing per test would re-pay
+# the packed compile bill ~10 times over. Tests that COUNT compiles
+# (the zero-marginal-compile gate) clear explicitly instead.
+@pytest.fixture(scope="module", autouse=True)
+def fresh_caches():
+    clear_program_caches()
+    yield
+    clear_program_caches()
+
+
+@pytest.fixture(autouse=True)
+def abi_on(monkeypatch):
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.delenv("PYCATKIN_FUSED_SWEEP", raising=False)
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+
+
+def _assert_tenant_bitwise(solo, packed, context=""):
+    assert sorted(solo) == sorted(packed), \
+        f"{context}: result keys drifted"
+    for key in solo:
+        a, b = np.asarray(solo[key]), np.asarray(packed[key])
+        assert a.dtype == b.dtype and a.shape == b.shape, \
+            f"{context}: {key!r} dtype/shape drifted"
+        assert a.tobytes() == b.tobytes(), \
+            f"{context}: {key!r} not bit-identical to the solo run"
+
+
+def _pack_vs_solo(tenants, check_stability=True,
+                  opts=SolverOptions()):
+    specs = [t[0].spec for t in tenants]
+    conds = [t[1] for t in tenants]
+    masks = [t[2] for t in tenants]
+    solo = [sweep_steady_state(s, c, tof_mask=m, opts=opts,
+                               check_stability=check_stability)
+            for s, c, m in zip(specs, conds, masks)]
+    packed = packed_sweep_steady_state(specs, conds, tof_mask=masks,
+                                       opts=opts,
+                                       check_stability=check_stability)
+    return solo, packed
+
+
+# ---------------------------------------------------------------------------
+# 1. key compatibility: the :tK sub-bucket
+
+
+def test_tenant_tag_contract():
+    assert compile_pool.tenant_tag(1) == ""
+    assert compile_pool.tenant_tag(0) == ""
+    assert compile_pool.tenant_tag(2) == ":t2"
+    assert compile_pool.tenant_tag(8) == ":t8"
+    with pytest.raises(ValueError):
+        compile_pool.tenant_tag(3)
+
+
+def test_abi_entry_fields_split_tenant_tag():
+    base = "abi-v1:s16:r16:d8:rt0:none"
+    f = compile_pool.abi_entry_fields(base + ":t4")
+    assert f["abi_bucket"] == "s16:r16:d8:rt0:none"
+    assert f["abi_tenants"] == 4
+    # Untagged (solo) fingerprints parse exactly as before.
+    f1 = compile_pool.abi_entry_fields(base)
+    assert f1["abi_bucket"] == "s16:r16:d8:rt0:none"
+    assert "abi_tenants" not in f1
+
+
+def test_pack_fingerprint_and_occupancy():
+    lows = [abi.lower_spec(_tenant(s)[0].spec) for s in SEEDS[:3]]
+    pack = abi.pack_lowered(lows)
+    assert pack.k == 3 and pack.k_bucket == 4
+    assert pack.occupancy == pytest.approx(0.75)
+    assert pack.abi_fingerprint == lows[0].abi_fingerprint + ":t4"
+    # Ghost slots replicate tenant 0's operands.
+    for key, arr in pack._np_operands.items():
+        assert arr.shape[0] == 4
+        np.testing.assert_array_equal(arr[3], arr[0], err_msg=key)
+
+
+def test_pack_rejects_mixed_buckets():
+    small = abi.lower_spec(_tenant(0)[0].spec)
+    big = abi.lower_spec(
+        synthetic_system(n_species=40, n_reactions=80, seed=5).spec)
+    assert small.program_spec is not big.program_spec
+    with pytest.raises(abi.AbiBucketError):
+        abi.pack_lowered([small, big])
+
+
+def test_single_tenant_delegates_to_solo_path():
+    sim, conds, mask = _tenant(0)
+    solo = sweep_steady_state(sim.spec, conds, tof_mask=mask)
+    outs = packed_sweep_steady_state([sim.spec], [conds],
+                                     tof_mask=[mask])
+    assert len(outs) == 1
+    _assert_tenant_bitwise(solo, outs[0], "K=1 delegation")
+
+
+# ---------------------------------------------------------------------------
+# 2. per-tenant bit-identity, corpora x tiers
+
+
+# The f32-polish variants, the rescue corpus and the escalation-path
+# drills re-trace/re-compile the packed zoo and dominate this file's
+# wall time, so they ride the slow tier; the dedicated packed CI lane
+# runs the file with ``-m ""`` and covers them on every push.
+@pytest.mark.parametrize(
+    "tier", ["f64", pytest.param("f32-polish", marks=pytest.mark.slow)])
+def test_clean_corpus_bit_identical(tier, monkeypatch):
+    monkeypatch.setenv(precision.TIER_ENV, tier)
+    tenants = [_tenant(s) for s in SEEDS]
+    solo, packed = _pack_vs_solo(tenants)
+    for k, (so, pa) in enumerate(zip(solo, packed)):
+        assert bool(np.all(np.asarray(so["success"]))), \
+            "clean corpus must converge solo"
+        _assert_tenant_bitwise(so, pa, f"clean/{tier}/tenant{k}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("tier", ["f64", "f32-polish"])
+def test_rescue_corpus_bit_identical(tier, monkeypatch):
+    """Crippled pacing fails real fast-pass lanes; each tenant must
+    walk the identical rescue ladder inside the pack."""
+    monkeypatch.setenv(precision.TIER_ENV, tier)
+    sims = [synthetic_system(n_species=24, n_reactions=32, seed=s)
+            for s in SEEDS[:2]]
+    tenants = []
+    for sim in sims:
+        conds = broadcast_conditions(sim.conditions(), N_LANES)
+        conds = conds._replace(
+            T=np.linspace(420.0, 780.0, N_LANES))
+        mask = engine.tof_mask_for(sim.spec, [sim.spec.rnames[-1]])
+        tenants.append((sim, conds, mask))
+    opts = SolverOptions(max_steps=6, max_attempts=2)
+    solo, packed = _pack_vs_solo(tenants, opts=opts)
+    if tier == "f64":
+        assert any(np.asarray(s["lane_telemetry"])[:, 3].max() >= 1
+                   for s in solo), \
+            "corpus exercised no rescue strategy -- drill premise broken"
+    for k, (so, pa) in enumerate(zip(solo, packed)):
+        _assert_tenant_bitwise(so, pa, f"rescue/{tier}/tenant{k}")
+
+
+@pytest.mark.slow
+def test_poisoned_tenant_isolated():
+    """One tenant with NaN-poisoned conditions escalates through the
+    failure tail; every OTHER tenant of the pack stays bit-identical
+    to its solo run, and the poisoned tenant itself matches ITS solo
+    escalation bit-for-bit."""
+    tenants = [_tenant(s) for s in SEEDS]
+    bad_T = np.asarray(tenants[1][1].T).copy()
+    bad_T[3] = np.nan
+    tenants[1] = (tenants[1][0], tenants[1][1]._replace(T=bad_T),
+                  tenants[1][2])
+    solo, packed = _pack_vs_solo(tenants)
+    assert not bool(np.all(np.asarray(solo[1]["success"]))), \
+        "poisoned tenant unexpectedly converged everywhere"
+    for k, (so, pa) in enumerate(zip(solo, packed)):
+        _assert_tenant_bitwise(so, pa, f"poisoned/tenant{k}")
+
+
+@pytest.mark.slow
+def test_fault_plan_degrades_to_solo_sweeps():
+    """Fault containment stays per-site: an active fault plan disables
+    the fused tail, so the packed API must degrade to per-tenant solo
+    sweeps (recording the degradation) rather than pack around the
+    injection machinery."""
+    tenants = [_tenant(s) for s in SEEDS[:2]]
+    specs = [t[0].spec for t in tenants]
+    conds = [t[1] for t in tenants]
+    profiling.drain_events()
+    plan = FaultPlan([FaultSpec(site="batched steady solve",
+                                kind="nan", lanes=(2,), times=1)])
+    with fault_scope(plan):
+        solo = [sweep_steady_state(s, c) for s, c in zip(specs, conds)]
+    plan2 = FaultPlan([FaultSpec(site="batched steady solve",
+                                 kind="nan", lanes=(2,), times=1)])
+    with fault_scope(plan2):
+        packed = packed_sweep_steady_state(specs, conds)
+    events = profiling.drain_events()
+    assert any(e.get("label") == "packed:solo-fallback"
+               for e in events)
+    for k, (so, pa) in enumerate(zip(solo, packed)):
+        _assert_tenant_bitwise(so, pa, f"faultplan/tenant{k}")
+
+
+# ---------------------------------------------------------------------------
+# 3. zero marginal compiles in a warm bucket
+
+
+def test_warm_bucket_pack_prewarms_with_zero_compiles(tmp_path):
+    clear_program_caches()     # this test COUNTS compiles: start cold
+    cache_dir = str(tmp_path / "aot")
+    os.environ["PYCATKIN_AOT_CACHE"] = cache_dir  # abi_on resets it
+    first = [_tenant(s) for s in SEEDS]
+    stats = prewarm_packed_sweep_programs(
+        [t[0].spec for t in first], [t[1] for t in first],
+        tof_mask=[t[2] for t in first])
+    assert int(stats) == 1
+    assert stats.compiled == 1 and stats.loaded == 0
+
+    # FRESH mechanisms, same bucket/K/lanes: the warm registry serves
+    # the pack -- zero marginal compiles is the acceptance gate.
+    fresh = [_tenant(s + 10) for s in SEEDS]
+    stats2 = prewarm_packed_sweep_programs(
+        [t[0].spec for t in fresh], [t[1] for t in fresh],
+        tof_mask=[t[2] for t in fresh])
+    assert stats2.compiled == 0, \
+        "a warm (bucket, K, lanes) pack performed a marginal compile"
+    assert stats2.loaded == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. the request coalescer
+
+
+def test_coalescer_groups_by_bucket_and_flushes_on_occupancy():
+    tenants = [_tenant(s) for s in SEEDS[:2]]
+    co = SweepCoalescer(max_occupancy=2, max_wait_s=1e9)
+    r0 = co.submit(tenants[0][0], tenants[0][1])
+    assert not r0.done and co.pending == 1
+    r1 = co.submit(tenants[1][0], tenants[1][1])
+    assert r0.done and r1.done and co.pending == 0
+    assert co.flushes == 1
+    solo = sweep_steady_state(tenants[0][0].spec, tenants[0][1])
+    _assert_tenant_bitwise(solo, r0.result(), "coalescer tenant 0")
+
+
+def test_coalescer_poll_and_result_force_flush():
+    sim, conds, _ = _tenant(0)
+    co = SweepCoalescer(max_occupancy=8, max_wait_s=1e9)
+    req = co.submit(sim, conds)
+    assert co.poll() == 0                      # deadline far away
+    assert co.poll(now=float("inf")) == 1      # max-wait expiry
+    assert req.done
+    co2 = SweepCoalescer(max_occupancy=8, max_wait_s=1e9)
+    req2 = co2.submit(sim, conds)
+    out = req2.result()                        # caller-forced flush
+    assert req2.done and out["y"] is not None
+
+
+def test_coalescer_emits_pack_flush_event(tmp_path):
+    import json
+    tenants = [_tenant(s) for s in SEEDS[:2]]
+    co = SweepCoalescer(max_occupancy=2, max_wait_s=1e9,
+                        work_dir=str(tmp_path))
+    for sim, conds, _ in tenants:
+        co.submit(sim, conds)
+    lines = [json.loads(line) for line in
+             open(tmp_path / "events.jsonl", encoding="utf-8")]
+    ev = next(e for e in lines if e.get("action") == "pack-flush")
+    assert ev["tenants"] == 2 and ev["k_bucket"] == 2
+    assert ev["pack_occupancy"] == pytest.approx(1.0)
+    assert ev["lanes"] == N_LANES
+    assert ev["tenant_quarantined"] == [0, 0]
+
+
+def test_dispatch_sweep_packed_mode():
+    tenants = [_tenant(s) for s in SEEDS]
+    outs = dispatch_sweep([t[0] for t in tenants],
+                          [t[1] for t in tenants], mode="packed")
+    assert len(outs) == len(tenants)
+    solo = sweep_steady_state(tenants[2][0].spec, tenants[2][1])
+    _assert_tenant_bitwise(solo, outs[2], "dispatch packed tenant 2")
+    with pytest.raises(ValueError):
+        dispatch_sweep(tenants[0][0], tenants[0][1], mode="bogus")

@@ -78,6 +78,41 @@ def test_fused_clean_sweep_spends_one_sync(problem):
     assert budget.labels == ["fused tail bundle"]
 
 
+@pytest.mark.parametrize("k", [2, 4])
+def test_packed_clean_sweep_spends_one_sync_regardless_of_k(
+        k, monkeypatch):
+    """The packed multi-tenant clean path is ONE counted sync TOTAL --
+    the stacked telemetry + bundle pull -- no matter how many tenants
+    share the dispatch. A per-tenant sync would scale the serving tax
+    linearly with K, which is exactly what packing exists to avoid."""
+    from pycatkin_tpu.frontend import abi
+    from pycatkin_tpu.parallel.batch import (clear_program_caches,
+                                             packed_sweep_steady_state)
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    clear_program_caches()
+    tenants = []
+    for seed in range(k):
+        sim = synthetic_system(n_species=12, n_reactions=14, seed=seed)
+        conds = broadcast_conditions(sim.conditions(), 8)
+        conds = conds._replace(T=np.linspace(440.0, 700.0, 8))
+        mask = engine.tof_mask_for(sim.spec, [sim.spec.rnames[-1]])
+        tenants.append((sim.spec, conds, mask))
+    specs = [t[0] for t in tenants]
+    conds_l = [t[1] for t in tenants]
+    masks = [t[2] for t in tenants]
+    packed_sweep_steady_state(specs, conds_l, tof_mask=masks)  # warm
+    with profiling.sync_budget() as budget:
+        outs = packed_sweep_steady_state(specs, conds_l, tof_mask=masks)
+    assert all(bool(np.all(np.asarray(o["success"]))) for o in outs), \
+        "budget only applies to a clean pack; this one had failures"
+    assert budget.count == 1, (
+        f"packed clean sweep (K={k}) spent {budget.count} counted "
+        f"syncs (expected exactly 1): {budget.labels}")
+    assert budget.labels == ["packed fused tail bundle"]
+    clear_program_caches()
+
+
 def test_legacy_clean_sweep_within_sync_budget(problem, monkeypatch):
     """The split tail (fused path disabled) must stay at 2 counted
     syncs: solve fence + packed tail bundle."""

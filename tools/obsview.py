@@ -130,6 +130,18 @@ def selftest(sweep: bool = False) -> int:
         return _fail(f"lane heatmap glyphs wrong:\n{heat}")
     print(heat)
 
+    # 5b. Tenant-grouped heatmap (packed multi-tenant sweeps).
+    from pycatkin_tpu.obs import (format_tenant_heatmaps,
+                                  tenant_lane_summaries)
+    tenants = [tel, [[3, 0, -9, 0, 0], [4, 1, -8, 1, 0]]]
+    per = tenant_lane_summaries(tenants)
+    if len(per) != 2 or per[0]["lanes"] != 4 or per[1]["lanes"] != 2:
+        return _fail(f"tenant lane summaries wrong: {per}")
+    theat = format_tenant_heatmaps(tenants, width=2)
+    if "tenant 0" not in theat or "tenant 1" not in theat:
+        return _fail(f"tenant heatmap grouping wrong:\n{theat}")
+    print(theat)
+
     # 6. Worker lifecycle timeline on scripted scheduler events.
     from pycatkin_tpu.obs import format_worker_timeline, worker_summary
     wev = [
@@ -142,14 +154,23 @@ def selftest(sweep: bool = False) -> int:
         {"kind": "worker", "action": "lease-stolen",
          "label": "lease:t00000_00004", "t": 103.0, "owner": "w1-12",
          "stolen_from": "w0-11"},
+        {"kind": "worker", "action": "pack-flush",
+         "label": "abi-v1:s16:r16:d8:rt0:none", "t": 104.0, "tenants": 3,
+         "k_bucket": 4, "pack_occupancy": 0.75, "lanes": 8,
+         "tenant_quarantined": [0, 2, 0]},
         {"kind": "span", "label": "not-a-worker-event", "dur": 1.0},
     ]
     ws = worker_summary(wev)
-    if ws["n_events"] != 4 or ws["restarts"].get("worker:0") != 1:
+    if ws["n_events"] != 5 or ws["restarts"].get("worker:0") != 1:
         return _fail(f"worker summary wrong: {ws}")
+    if (ws.get("packs") != 1 or ws.get("pack_tenants") != 3
+            or ws.get("tenant_quarantined", {}).get(
+                "abi-v1:s16:r16:d8:rt0:none[1]") != 2):
+        return _fail(f"pack-flush aggregation wrong: {ws}")
     timeline = format_worker_timeline(wev)
     if ("lease-stolen" not in timeline or "signal-death" not in timeline
-            or "2.500s" not in timeline):
+            or "2.500s" not in timeline
+            or "tenant_quarantined=[0, 2, 0]" not in timeline):
         return _fail(f"worker timeline rendering wrong:\n{timeline}")
     print(timeline)
 
@@ -191,23 +212,24 @@ def selftest(sweep: bool = False) -> int:
     return 0
 
 
-def _find_lane_telemetry(obj):
-    """Depth-first hunt for a 'lane_telemetry' array in a JSON object
-    (bench records nest the sweep output; BENCH_r*.json wraps it again
-    under 'parsed')."""
+def _find_lane_telemetry(obj, key="lane_telemetry"):
+    """Depth-first hunt for a telemetry array in a JSON object (bench
+    records nest the sweep output; BENCH_r*.json wraps it again under
+    'parsed'). ``key="tenant_lane_telemetry"`` finds a packed sweep's
+    per-tenant list instead."""
     if isinstance(obj, dict):
-        tel = obj.get("lane_telemetry")
+        tel = obj.get(key)
         if tel is not None:
             return tel
         for v in obj.values():
-            tel = _find_lane_telemetry(v)
+            tel = _find_lane_telemetry(v, key)
             if tel is not None:
                 return tel
     return None
 
 
 def workers_view(path: str) -> int:
-    from pycatkin_tpu.obs import format_worker_timeline
+    from pycatkin_tpu.obs import format_worker_timeline, worker_summary
     try:
         if path.endswith(".jsonl"):
             from pycatkin_tpu.utils.io import read_json_lines
@@ -222,6 +244,17 @@ def workers_view(path: str) -> int:
     if not isinstance(events, list):
         return _fail(f"{path}: no event list found")
     print(format_worker_timeline(events))
+    ws = worker_summary([e for e in events if isinstance(e, dict)])
+    if ws.get("packs"):
+        print(f"packed flushes: {ws['packs']} "
+              f"({ws['pack_tenants']} tenant sweeps)")
+        tq = ws.get("tenant_quarantined") or {}
+        if tq:
+            print("per-tenant quarantined lanes:")
+            for key in sorted(tq):
+                print(f"  {key}: {tq[key]}")
+        else:
+            print("per-tenant quarantined lanes: none")
     if not any(e.get("kind") == "worker" for e in events
                if isinstance(e, dict)):
         return _fail(f"{path}: no worker lifecycle events in the file")
@@ -229,16 +262,27 @@ def workers_view(path: str) -> int:
 
 
 def lanes_view(path: str) -> int:
-    from pycatkin_tpu.obs import format_lane_heatmap
+    from pycatkin_tpu.obs import (format_lane_heatmap,
+                                  format_tenant_heatmaps)
     try:
         with open(path, encoding="utf-8") as fh:
             obj = json.load(fh)
     except (OSError, ValueError) as e:
         return _fail(str(e))
+    # A packed multi-tenant record renders one heatmap block per
+    # tenant; a solo record keeps the flat heatmap.
+    tenants = _find_lane_telemetry(obj, key="tenant_lane_telemetry")
+    if tenants is not None:
+        try:
+            print(format_tenant_heatmaps(tenants))
+        except (TypeError, ValueError) as e:
+            return _fail(f"{path}: malformed tenant telemetry ({e})")
+        return 0
     tel = _find_lane_telemetry(obj)
     if tel is None:
-        return _fail(f"{path}: no 'lane_telemetry' array anywhere in "
-                     f"the JSON")
+        return _fail(f"{path}: no 'lane_telemetry' (or "
+                     f"'tenant_lane_telemetry') array anywhere in the "
+                     f"JSON")
     try:
         print(format_lane_heatmap(tel))
     except (TypeError, ValueError) as e:
