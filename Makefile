@@ -25,14 +25,21 @@
 # regression. `chaos` is the elastic-scheduler drill
 # (docs/failure_model.md): a small lease-scheduled multi-process sweep
 # with an injected worker crash that must finish with zero lost lanes
-# and at least one supervised restart.
+# and at least one supervised restart. `serve-check` is the serving
+# lane (docs/serving.md): a two-process pack-boot proof -- process 1
+# soaks a small request stream against an empty AOT cache and exports
+# the warmed cache as a pack, process 2 boots its server FROM that
+# pack (prewarm must compile nothing), streams ~64 TCP requests, and
+# gates on a 100% post-warmup zero-compile rate, the p99 budget,
+# schema-complete responses (manifest/telemetry/quarantine present)
+# and a loss-free drain.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-all lint \
 	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest \
-	obs-check perfwatch chaos
+	obs-check perfwatch chaos serve-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -85,3 +92,6 @@ perfwatch:
 chaos:
 	env JAX_PLATFORMS=cpu python -m pycatkin_tpu.robustness.scheduler \
 		--drill
+
+serve-check:
+	env JAX_PLATFORMS=cpu python tools/soak.py --check

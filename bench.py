@@ -877,6 +877,26 @@ def smoke_main():
         except Exception as e:  # noqa: BLE001 - gate reports & fails
             packed = {"error": str(e), "packed_ok": False}
         packed_ok = bool(packed.get("packed_ok"))
+
+        # Serve gate (ISSUE-13): a miniature soak through the live
+        # serving path (docs/serving.md) -- boot, warm, stream a
+        # packed burst, drain -- gated on the shared SLO checks
+        # (100% zero-compile rate after warmup, schema-complete
+        # responses, loss-free drain). Runs inside the scratch AOT
+        # cache block so the serve zoo never touches the repo cache;
+        # the serve sub-object feeds the perfwatch history
+        # (serve_p50_s / serve_p99_s / ...).
+        from pycatkin_tpu.serve.soak import check_soak_record, run_soak
+        try:
+            serve_rec = run_soak(
+                n_requests=12, buckets=(16,), lanes=3,
+                mechs_per_bucket=2, max_occupancy=4, concurrency=8)
+            serve_problems = check_soak_record(serve_rec)
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            serve_rec = {"serve": {"error": str(e)}}
+            serve_problems = [f"serve soak crashed: {e}"]
+        serve = serve_rec.get("serve") or {}
+        serve_ok = not serve_problems
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -1008,6 +1028,8 @@ def smoke_main():
         "abi_zero_compile_ok": abi_zero_compile_ok,
         "packed": packed,
         "packed_ok": packed_ok,
+        "serve": serve,
+        "serve_ok": serve_ok,
         "lint_ok": True,
         "lint_findings": 0,
         "trace_ok": trace_ok,
@@ -1069,6 +1091,10 @@ def smoke_main():
                   or "; ".join(packed.get("failures") or ())
                   or "no rows")
         log(f"bench-smoke: FAIL -- packed-batch gate: {detail}")
+        return 1
+    if not serve_ok:
+        log(f"bench-smoke: FAIL -- serve gate: "
+            f"{'; '.join(serve_problems)}")
         return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "

@@ -101,3 +101,91 @@ def synthetic_system(n_species: int = 200, n_reactions: int = 500,
         start[gst.name] = frac
     sys.params["start_state"] = start
     return sys
+
+
+# Bucket-targeted shapes: one FIXED (n_species, n_reactions) pair per
+# ABI species bucket. Fixing the shape (rather than drawing it from
+# the seed) pins the whole fingerprint -- padded dims, dynamic
+# sub-bucket, reactor code -- so every seed of a bucket lands in the
+# SAME interned program spec and the serving layer's coalescer can
+# pack them as co-tenants. The ABI counts TS states too, so the
+# lowered species count is n_species + n_reactions - n_gas (one TS
+# per surface step); shapes below sit mid-bucket under that formula.
+_BUCKET_SHAPES = {
+    16: (10, 5),
+    32: (15, 12),
+    128: (60, 40),
+    512: (200, 200),
+}
+
+
+def _lowered_species(n_species: int, n_reactions: int) -> int:
+    n_gas = max(2, n_species // 20)
+    return n_species + n_reactions - n_gas
+
+
+def synthetic_system_for_bucket(species_bucket: int, seed: int = 0,
+                                n_species: int | None = None,
+                                n_reactions: int | None = None,
+                                T: float = 500.0, p: float = 1.0e5,
+                                barrier_range: tuple = (0.1, 1.6)
+                                ) -> System:
+    """A :func:`synthetic_system` guaranteed to lower into the
+    requested ABI species bucket -- the soak harness's occupancy
+    control knob (``pycatkin_tpu/serve``): requests generated with the
+    same ``species_bucket`` (any seed) share one ABI fingerprint and
+    therefore one packed program, so a soak can steer load bucket by
+    bucket.
+
+    ``n_species`` / ``n_reactions`` override the bucket's default
+    shape but are validated against it; an impossible request (unknown
+    bucket, a species count that lowers elsewhere, a reaction count
+    the generator cannot realize) raises ``ValueError`` with the
+    reason rather than silently generating a mechanism in the wrong
+    bucket. The build is verified by actually lowering the spec
+    through :func:`frontend.abi.select_static`."""
+    from ..frontend import abi
+
+    if species_bucket not in abi.SPECIES_BUCKETS:
+        raise ValueError(
+            f"species_bucket {species_bucket} is not an ABI bucket; "
+            f"choose one of {abi.SPECIES_BUCKETS}")
+    lo = ([b for b in abi.SPECIES_BUCKETS if b < species_bucket]
+          or [0])[-1]
+    n_s, n_r = _BUCKET_SHAPES[species_bucket]
+    if n_species is not None:
+        n_s = int(n_species)
+    if n_reactions is not None:
+        n_r = int(n_reactions)
+    n_gas = max(2, n_s // 20)
+    # +1 below mirrors abi.select_static's reserved pad slot.
+    total = _lowered_species(n_s, n_r)
+    if not (lo < total + 1 <= species_bucket):
+        raise ValueError(
+            f"n_species={n_s}/n_reactions={n_r} lower to {total} ABI "
+            f"species (TS states included), i.e. bucket "
+            f"{abi._bucket_for(total + 1, abi.SPECIES_BUCKETS)}, not "
+            f"the requested {species_bucket} (need {lo} < "
+            f"n_species + n_reactions - {n_gas} + 1 <= {species_bucket})")
+    if n_s - n_gas - 1 < n_gas:
+        raise ValueError(
+            f"n_species={n_s} is too small for the generator's gas "
+            f"star ({n_gas} gas species need at least as many "
+            f"adsorbates)")
+    if n_r <= n_gas:
+        raise ValueError(
+            f"n_reactions={n_r} cannot cover the {n_gas} adsorption "
+            f"steps the generator emits (need n_reactions > {n_gas})")
+    if n_r > max(abi.REACTION_BUCKETS):
+        raise ValueError(
+            f"n_reactions={n_r} exceeds the largest ABI reaction "
+            f"bucket {max(abi.REACTION_BUCKETS)}")
+    sys = synthetic_system(n_species=n_s, n_reactions=n_r, seed=seed,
+                           T=T, p=p, barrier_range=barrier_range)
+    st = abi.select_static(sys.spec)
+    if st.n_species != species_bucket:
+        raise ValueError(
+            f"generated mechanism lowered into species bucket "
+            f"{st.n_species}, not the requested {species_bucket} "
+            f"(generator/ABI drift -- report this)")
+    return sys

@@ -30,6 +30,12 @@ TRACKED_METRICS = {
     "prewarm_warm_s": "lower",      # warm-disk restart cost
     "prewarm_warm_pack_s": "lower",  # warm-from-pack boot cost
     "max_over_median": "lower",     # trial variance
+    # Serving SLOs (serve-soak records and bench smoke's serve gate;
+    # pulled from the record's "serve" sub-object by extract_metrics).
+    "serve_p50_s": "lower",         # median request latency
+    "serve_p99_s": "lower",         # tail request latency
+    "serve_zero_compile_rate": "higher",  # post-warmup compile hygiene
+    "serve_mean_occupancy": "higher",     # achieved pack occupancy
 }
 
 # A regression must clear BOTH gates: beyond ``mad_k`` median absolute
@@ -67,14 +73,20 @@ def _unwrap(record: dict) -> dict:
 def extract_metrics(record: dict) -> dict:
     """``{metric: float}`` of every tracked, present, finite metric in
     one (possibly wrapped) bench record. ``mfu`` is pulled from the
-    cost-ledger totals when the record carries one."""
+    cost-ledger totals when the record carries one; ``serve_*``
+    metrics fall back to the ``serve`` sub-object a serve-soak record
+    (or the smoke gate) nests them under."""
     rec = _unwrap(record)
+    serve = rec.get("serve") if isinstance(rec.get("serve"),
+                                           dict) else {}
     out = {}
     for key in TRACKED_METRICS:
         v = rec.get(key)
         if key == "mfu" and v is None:
             v = ((rec.get("cost_ledger") or {}).get("totals")
                  or {}).get("mfu")
+        if v is None and key.startswith("serve_"):
+            v = serve.get(key[len("serve_"):])
         try:
             f = float(v)
         except (TypeError, ValueError):

@@ -28,6 +28,7 @@ host-only workers).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import subprocess
@@ -62,19 +63,27 @@ class PackedRequest:
     """One tenant's pending sweep inside a :class:`SweepCoalescer`
     group. ``result()`` blocks nothing: if the group has not flushed
     yet it flushes NOW (the submitting caller asking for its answer is
-    the strongest possible "stop waiting for co-tenants" signal)."""
+    the strongest possible "stop waiting for co-tenants" signal).
+
+    ``submitted_at`` / ``wait_budget_s`` record when the request
+    arrived and how long it agreed to wait for co-tenants (None =
+    the coalescer's ``max_wait_s``); the serving layer's SLA-aware
+    flushing derives group deadlines from them."""
 
     __slots__ = ("sim", "spec", "conds", "tof_mask", "x0", "group_key",
+                 "submitted_at", "wait_budget_s",
                  "_coalescer", "_result", "done")
 
     def __init__(self, coalescer, sim, spec, conds, tof_mask, x0,
-                 group_key):
+                 group_key, submitted_at=None, wait_budget_s=None):
         self.sim = sim
         self.spec = spec
         self.conds = conds
         self.tof_mask = tof_mask
         self.x0 = x0
         self.group_key = group_key
+        self.submitted_at = submitted_at
+        self.wait_budget_s = wait_budget_s
         self._coalescer = coalescer
         self._result = None
         self.done = False
@@ -126,13 +135,25 @@ class SweepCoalescer:
     When ``work_dir`` is given, every flush appends a ``pack-flush``
     worker event (tenants, occupancy, lanes, per-tenant quarantine
     counts) to ``work_dir/events.jsonl`` -- the same file the elastic
-    scheduler and ``tools/obsview.py --workers`` read."""
+    scheduler and ``tools/obsview.py --workers`` read.
+
+    ``autoflush=False`` turns the coalescer into a pure queue for an
+    external scheduler (the serving layer, ``pycatkin_tpu/serve``):
+    ``submit`` never runs the solver inline; the owner polls
+    :meth:`due_keys`, pops ripe groups with :meth:`take_group` (safe
+    to call under a lock -- it only mutates dicts) and executes them
+    with :meth:`run_requests` wherever it likes (a worker thread, the
+    elastic queue). ``submit(..., wait_budget_s=...)`` tightens the
+    group's flush deadline below ``max_wait_s`` per request -- the
+    SLA-aware hook: a group's deadline is the EARLIEST budget of its
+    members, so one latency-sensitive tenant flushes the whole pack
+    early instead of burning its budget waiting for stragglers."""
 
     def __init__(self, runner=None, max_occupancy: Optional[int] = None,
                  max_wait_s: Optional[float] = None,
                  work_dir: Optional[str] = None,
                  check_stability: bool = False, opts=None,
-                 pos_jac_tol: float = 1e-2):
+                 pos_jac_tol: float = 1e-2, autoflush: bool = True):
         if max_occupancy is None:
             max_occupancy = int(os.environ.get(
                 PACKED_MAX_OCCUPANCY_ENV, _PACKED_MAX_OCCUPANCY_DEFAULT))
@@ -149,8 +170,13 @@ class SweepCoalescer:
         self.check_stability = bool(check_stability)
         self.opts = opts
         self.pos_jac_tol = float(pos_jac_tol)
+        self.autoflush = bool(autoflush)
         self._groups: dict = {}
         self._deadlines: dict = {}
+        # Monotonic solo-group sequence: ``id(sim)`` is reusable after
+        # GC, so two distinct unfittable sims submitted over a server's
+        # lifetime could alias one key and silently co-flush.
+        self._solo_seq = itertools.count()
         self.flushes = 0
 
     def _group_key(self, sim, spec, conds, tof_mask, x0):
@@ -166,22 +192,38 @@ class SweepCoalescer:
             fp = None
         if fp is None:
             # Unpackable mechanism: unique key -> always a solo group.
-            return ("solo", id(sim), n)
+            return ("solo", next(self._solo_seq), n)
         return (fp, n, tof_mask is not None, x0 is not None)
 
-    def submit(self, sim, conds, tof_mask=None, x0=None) -> PackedRequest:
+    def _deadline_for(self, reqs) -> float:
+        """The group flush deadline its members imply: the earliest
+        ``submitted_at + wait_budget_s`` (``max_wait_s`` for members
+        without a budget)."""
+        return min(r.submitted_at
+                   + (self.max_wait_s if r.wait_budget_s is None
+                      else float(r.wait_budget_s))
+                   for r in reqs)
+
+    def submit(self, sim, conds, tof_mask=None, x0=None,
+               wait_budget_s: Optional[float] = None) -> PackedRequest:
         """Queue one sweep; returns its :class:`PackedRequest` handle.
-        Flushes the group immediately when it reaches
-        ``max_occupancy``."""
+        With ``autoflush`` (the default) the group flushes inline when
+        it reaches ``max_occupancy``. ``wait_budget_s`` caps how long
+        THIS request may sit waiting for co-tenants (tightening the
+        group deadline below ``max_wait_s``) -- the serving layer
+        derives it from the request's deadline class."""
+        import time as _time
         spec = getattr(sim, "spec", sim)
         key = self._group_key(sim, spec, conds, tof_mask, x0)
-        req = PackedRequest(self, sim, spec, conds, tof_mask, x0, key)
+        req = PackedRequest(self, sim, spec, conds, tof_mask, x0, key,
+                            submitted_at=_time.monotonic(),
+                            wait_budget_s=wait_budget_s)
         group = self._groups.setdefault(key, [])
-        if not group:
-            import time as _time
-            self._deadlines[key] = _time.monotonic() + self.max_wait_s
         group.append(req)
-        if len(group) >= self.max_occupancy:
+        self._deadlines[key] = min(
+            self._deadlines.get(key, float("inf")),
+            self._deadline_for([req]))
+        if self.autoflush and len(group) >= self.max_occupancy:
             self.flush_group(key)
         return req
 
@@ -189,29 +231,68 @@ class SweepCoalescer:
     def pending(self) -> int:
         return sum(len(g) for g in self._groups.values())
 
-    def poll(self, now: Optional[float] = None) -> int:
-        """Flush every group whose oldest request exceeded
-        ``max_wait_s``; returns how many groups flushed. A serving loop
-        calls this on its idle tick."""
+    def due_keys(self, now: Optional[float] = None) -> list:
+        """Keys of every group ripe for flushing: at/over
+        ``max_occupancy``, or past its deadline (``max_wait_s`` or the
+        tightest submitted ``wait_budget_s``, whichever came first). A
+        caller-supplied ``now`` earlier than every deadline -- a clock
+        that moved backwards -- simply reports nothing due."""
         import time as _time
         now = _time.monotonic() if now is None else now
-        due = [k for k, d in self._deadlines.items() if now >= d]
+        due = [k for k, g in self._groups.items()
+               if len(g) >= self.max_occupancy]
+        for key, d in self._deadlines.items():
+            if now >= d and key not in due and key in self._groups:
+                due.append(key)
+        return due
+
+    def poll(self, now: Optional[float] = None) -> int:
+        """Flush every group whose oldest request exceeded its wait
+        budget; returns how many groups flushed. A serving loop calls
+        this on its idle tick."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        due = [k for k, d in self._deadlines.items()
+               if now >= d and self._groups.get(k)]
         for key in due:
             self.flush_group(key)
         return len(due)
 
     def flush_all(self) -> int:
         """Flush every pending group regardless of age/occupancy."""
-        keys = list(self._groups)
-        for key in keys:
-            self.flush_group(key)
-        return len(keys)
+        flushed = 0
+        for key in list(self._groups):
+            reqs = self.take_group(key)
+            if reqs:
+                self.run_requests(key, reqs)
+                flushed += 1
+        return flushed
 
-    def flush_group(self, key) -> None:
-        reqs = self._groups.pop(key, None)
-        self._deadlines.pop(key, None)
+    def take_group(self, key, limit: Optional[int] = None) -> list:
+        """Pop up to ``limit`` (all, if None) requests of one group,
+        leaving any remainder queued with a recomputed deadline.
+        Mutates only the queue dicts -- never runs the solver -- so an
+        external scheduler may call it under a lock and execute the
+        returned requests elsewhere. Returns ``[]`` for a key already
+        taken (the benign half of a flush race)."""
+        reqs = self._groups.get(key)
         if not reqs:
-            return
+            self._groups.pop(key, None)
+            self._deadlines.pop(key, None)
+            return []
+        if limit is None or len(reqs) <= limit:
+            self._groups.pop(key, None)
+            self._deadlines.pop(key, None)
+            return reqs
+        taken, rest = reqs[:limit], reqs[limit:]
+        self._groups[key] = rest
+        self._deadlines[key] = self._deadline_for(rest)
+        return taken
+
+    def run_requests(self, key, reqs) -> list:
+        """Execute one taken group through ``runner`` NOW, resolve its
+        requests and emit the pack-flush event; returns the per-tenant
+        result dicts in request order."""
         masks = [r.tof_mask for r in reqs]
         x0s = [r.x0 for r in reqs]
         outs = self.runner(
@@ -227,6 +308,12 @@ class SweepCoalescer:
             r.done = True
         self.flushes += 1
         self._emit_flush(key, reqs, outs)
+        return outs
+
+    def flush_group(self, key) -> None:
+        reqs = self.take_group(key)
+        if reqs:
+            self.run_requests(key, reqs)
 
     def _emit_flush(self, key, reqs, outs) -> None:
         from ..utils.profiling import record_event

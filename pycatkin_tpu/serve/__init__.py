@@ -1,0 +1,25 @@
+"""Sweep-as-a-service: the long-lived process that owns the warm
+cache, the request queue and the mesh.
+
+Eleven PRs built every organ of a serving system in batch form --
+ABI-bucketed lowering (frontend/abi.py), multi-tenant packing
+(parallel/batch.py), the request coalescer (parallel/dispatch.py), the
+elastic lease queue (robustness/scheduler.py), AOT cache packs
+(parallel/compile_pool.py, tools/aot_pack.py) and the observability
+stack (obs/) -- but nothing *stayed alive* between requests. This
+package is that process: a single-process asyncio server speaking
+JSON-lines over TCP (plus an in-process :class:`SweepClient`) that
+admits mechanism + conditions-grid requests, coalesces same-bucket
+tenants into packed dispatches with SLA-aware flushing, and answers
+every request with its run manifest, per-lane telemetry and quarantine
+report. Schema and semantics: docs/serving.md.
+"""
+
+from .client import SweepClient, TcpSweepClient
+from .protocol import (DEADLINE_CLASSES, ServeConfig, ServeError,
+                       error_response)
+from .server import SweepServer
+
+__all__ = ["SweepServer", "SweepClient", "TcpSweepClient",
+           "ServeConfig", "ServeError", "DEADLINE_CLASSES",
+           "error_response"]

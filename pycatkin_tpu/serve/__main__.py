@@ -1,0 +1,70 @@
+"""``python -m pycatkin_tpu.serve`` -- run a sweep server until
+drained (SIGINT/SIGTERM trigger the graceful drain path).
+
+Configuration comes from the ``PYCATKIN_SERVE_*`` environment knobs
+(docs/index.md registry) and the flags below; the bound port is
+printed as a JSON line on stdout so a supervisor can scrape it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+
+
+async def _amain(args) -> int:
+    from .protocol import ServeConfig
+    from .server import SweepServer
+
+    cfg = ServeConfig(
+        host=args.host, port=args.port, runner=args.runner,
+        aot_pack=args.aot_pack, work_dir=args.work_dir,
+        max_occupancy=args.max_occupancy)
+    server = await SweepServer(cfg).start()
+    print(json.dumps({"serving": True, "host": cfg.host,
+                      "port": server.port}), flush=True)
+
+    loop = asyncio.get_running_loop()
+    draining = asyncio.Event()
+
+    def _trigger_drain():
+        if not draining.is_set():
+            draining.set()
+            loop.create_task(server.drain())
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, _trigger_drain)
+        except (NotImplementedError, OSError):
+            pass
+    # Serve until something (a signal, or a client "drain" op) drains
+    # the server and its scheduler loop exits.
+    while server._scheduler_task is not None:
+        await asyncio.sleep(0.1)
+    print(json.dumps({"serving": False,
+                      "stats": server.stats()}), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pycatkin_tpu.serve",
+        description="Run the sweep-as-a-service server.")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None,
+                    help="0 binds an ephemeral port (printed)")
+    ap.add_argument("--runner", choices=("inproc", "elastic"),
+                    default=None)
+    ap.add_argument("--aot-pack", default=None,
+                    help="AOT cache pack imported before listening")
+    ap.add_argument("--work-dir", default=None)
+    ap.add_argument("--max-occupancy", type=int, default=None)
+    args = ap.parse_args(argv)
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

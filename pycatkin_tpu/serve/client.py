@@ -1,0 +1,149 @@
+"""Clients for the sweep service: in-process and JSON-lines-over-TCP.
+
+:class:`SweepClient` talks straight to a :class:`serve.server.
+SweepServer` object in the same process -- no serialization, and the
+mechanism may be a built ``System`` (the soak harness's fast path).
+:class:`TcpSweepClient` speaks the wire protocol; it multiplexes any
+number of in-flight requests over one connection by matching response
+``id`` to request ``id``, which is what lets K co-tenants of a packed
+group be pending simultaneously from a single client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+from typing import Optional
+
+from .protocol import E_INTERNAL, error_response
+
+
+def sweep_payload(mechanism, T, p=1.0e5, tof_terms=None,
+                  deadline_class: str = "standard",
+                  wait_budget_s: Optional[float] = None,
+                  want=(), req_id=None) -> dict:
+    """Assemble one sweep request object (docs/serving.md schema)."""
+    payload = {
+        "op": "sweep", "id": req_id, "mechanism": mechanism,
+        "conditions": {
+            "T": list(T) if isinstance(T, (list, tuple)) else [T],
+            "p": list(p) if isinstance(p, (list, tuple)) else p},
+        "deadline_class": deadline_class,
+    }
+    if tof_terms:
+        payload["tof_terms"] = list(tof_terms)
+    if wait_budget_s is not None:
+        payload["wait_budget_s"] = float(wait_budget_s)
+    if want:
+        payload["return"] = list(want)
+    return payload
+
+
+class SweepClient:
+    """In-process client: calls the server's request handler directly.
+    The ``mechanism`` may be a built ``System`` (skipping the JSON
+    round-trip) or a reference-schema dict."""
+
+    def __init__(self, server):
+        self._server = server
+        self._seq = itertools.count()
+
+    async def sweep(self, mechanism, T, p=1.0e5, **kwargs) -> dict:
+        req_id = kwargs.pop("req_id", None) or f"c{next(self._seq)}"
+        return await self._server.handle(
+            sweep_payload(mechanism, T, p=p, req_id=req_id, **kwargs))
+
+    async def ping(self) -> dict:
+        return await self._server.handle({"op": "ping"})
+
+    async def stats(self) -> dict:
+        return await self._server.handle({"op": "stats"})
+
+    async def drain(self) -> dict:
+        return await self._server.handle({"op": "drain"})
+
+
+class TcpSweepClient:
+    """JSON-lines TCP client with id-multiplexed in-flight requests."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._reader = None
+        self._writer = None
+        self._pending: dict = {}
+        self._seq = itertools.count()
+        self._read_task = None
+        self._wlock = asyncio.Lock()
+
+    async def connect(self) -> "TcpSweepClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._read_task = asyncio.get_running_loop().create_task(
+            self._read_loop())
+        return self
+
+    async def _read_loop(self):
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                try:
+                    resp = json.loads(line)
+                except ValueError:
+                    continue
+                fut = self._pending.pop(resp.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resp)
+        finally:
+            # Connection gone: fail whatever is still waiting rather
+            # than hanging the caller forever.
+            err = error_response(None, E_INTERNAL, "connection closed")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_result(dict(err))
+            self._pending.clear()
+
+    async def request(self, payload: dict) -> dict:
+        """Send one request object; resolves when ITS response (by
+        ``id``) arrives, regardless of interleaving."""
+        if payload.get("id") is None:
+            payload = dict(payload, id=f"t{next(self._seq)}")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[payload["id"]] = fut
+        data = (json.dumps(payload) + "\n").encode()
+        async with self._wlock:
+            self._writer.write(data)
+            await self._writer.drain()
+        return await fut
+
+    async def sweep(self, mechanism, T, p=1.0e5, **kwargs) -> dict:
+        return await self.request(
+            sweep_payload(mechanism, T, p=p, **kwargs))
+
+    async def ping(self) -> dict:
+        return await self.request({"op": "ping"})
+
+    async def stats(self) -> dict:
+        return await self.request({"op": "stats"})
+
+    async def drain(self) -> dict:
+        return await self.request({"op": "drain"})
+
+    async def close(self):
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except asyncio.CancelledError:
+                pass
+            self._read_task = None

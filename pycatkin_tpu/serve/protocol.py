@@ -1,0 +1,182 @@
+"""Wire schema and knobs of the serving layer (docs/serving.md).
+
+One JSON object per line, both directions. Requests carry ``op``
+("sweep" | "ping" | "stats" | "drain") and, for sweeps, a mechanism in
+the reference input-file schema (utils/io.system_to_dict), a
+conditions grid, and a deadline class. Responses echo the request
+``id`` and either ``ok: true`` with the result payload or ``ok: false``
+with a structured error -- admission control rejects are data, not
+dropped connections.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+PROTOCOL = "pycatkin-serve/v1"
+
+# Env knobs (PCL006 registry rows in docs/index.md).
+HOST_ENV = "PYCATKIN_SERVE_HOST"
+PORT_ENV = "PYCATKIN_SERVE_PORT"
+MAX_PENDING_ENV = "PYCATKIN_SERVE_MAX_PENDING"
+RUNNER_ENV = "PYCATKIN_SERVE_RUNNER"
+AOT_PACK_ENV = "PYCATKIN_SERVE_AOT_PACK"
+BUDGET_INTERACTIVE_ENV = "PYCATKIN_SERVE_BUDGET_INTERACTIVE"
+BUDGET_STANDARD_ENV = "PYCATKIN_SERVE_BUDGET_STANDARD"
+BUDGET_BATCH_ENV = "PYCATKIN_SERVE_BUDGET_BATCH"
+
+_DEFAULT_BUDGETS = {"interactive": 0.02, "standard": 0.2, "batch": 2.0}
+_BUDGET_ENVS = {"interactive": BUDGET_INTERACTIVE_ENV,
+                "standard": BUDGET_STANDARD_ENV,
+                "batch": BUDGET_BATCH_ENV}
+DEADLINE_CLASSES = tuple(_DEFAULT_BUDGETS)
+
+# Structured reject/error codes (docs/serving.md).
+E_BAD_REQUEST = "bad_request"
+E_OVERLOADED = "overloaded"
+E_DRAINING = "draining"
+E_INTERNAL = "internal"
+
+
+class ServeError(Exception):
+    """A request failure that maps to a structured error response."""
+
+    def __init__(self, code: str, message: str):
+        self.code = code
+        super().__init__(message)
+
+
+def deadline_budgets() -> dict:
+    """Per-class coalescing wait budgets (seconds a request may sit
+    waiting for co-tenants), env-overridable per class."""
+    out = {}
+    for cls, default in _DEFAULT_BUDGETS.items():
+        out[cls] = float(os.environ.get(_BUDGET_ENVS[cls], default))
+    return out
+
+
+@dataclass
+class ServeConfig:
+    """Everything a :class:`serve.server.SweepServer` needs to boot.
+    ``None`` fields resolve from the environment at construction."""
+
+    host: Optional[str] = None
+    port: Optional[int] = None
+    max_pending: Optional[int] = None
+    runner: Optional[str] = None          # "inproc" | "elastic"
+    aot_pack: Optional[str] = None        # pack imported before listen
+    work_dir: Optional[str] = None        # events + elastic group dirs
+    max_occupancy: Optional[int] = None   # coalescer knob passthrough
+    max_wait_s: Optional[float] = None
+    tick_s: float = 0.005                 # scheduler poll period
+    n_workers: int = 2                    # elastic runner width
+    budgets: dict = field(default_factory=deadline_budgets)
+
+    def __post_init__(self):
+        if self.host is None:
+            self.host = os.environ.get(HOST_ENV, "127.0.0.1")
+        if self.port is None:
+            self.port = int(os.environ.get(PORT_ENV, "0"))
+        if self.max_pending is None:
+            self.max_pending = int(os.environ.get(MAX_PENDING_ENV,
+                                                  "256"))
+        if self.runner is None:
+            self.runner = os.environ.get(RUNNER_ENV, "inproc")
+        if self.runner not in ("inproc", "elastic"):
+            raise ValueError(f"runner must be 'inproc' or 'elastic', "
+                             f"got {self.runner!r}")
+        if self.aot_pack is None:
+            self.aot_pack = os.environ.get(AOT_PACK_ENV) or None
+        if self.max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, "
+                             f"got {self.max_pending}")
+
+    def wait_budget_for(self, deadline_class: str) -> float:
+        try:
+            return float(self.budgets[deadline_class])
+        except KeyError:
+            raise ServeError(
+                E_BAD_REQUEST,
+                f"unknown deadline_class {deadline_class!r}; choose "
+                f"one of {sorted(self.budgets)}") from None
+
+
+def jsonable(obj):
+    """Recursively convert a result payload (numpy arrays/scalars,
+    nested dicts/sequences) into plain JSON types."""
+    if isinstance(obj, dict):
+        return {str(k): jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return jsonable(obj.tolist())
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        v = float(obj)
+        return v if np.isfinite(v) else repr(v)
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(obj, "__array__"):  # jax Arrays land here
+        return jsonable(np.asarray(obj))
+    return repr(obj)
+
+
+def error_response(req_id, code: str, message: str) -> dict:
+    return {"protocol": PROTOCOL, "id": req_id, "ok": False,
+            "error": {"code": code, "message": message}}
+
+
+def parse_sweep_request(payload: dict) -> dict:
+    """Validate the sweep-specific fields of a request payload; returns
+    ``{mechanism, T(list), p(list), tof_terms, deadline_class,
+    wait_budget_s, want}``. Raises :class:`ServeError` (bad_request)
+    with the offending field named."""
+    mech = payload.get("mechanism")
+    if mech is None:
+        raise ServeError(E_BAD_REQUEST, "/mechanism: missing (expected "
+                         "reference input-file JSON or a built System)")
+    conds = payload.get("conditions")
+    if not isinstance(conds, dict):
+        raise ServeError(E_BAD_REQUEST,
+                         "/conditions: expected an object like "
+                         '{"T": [500, 550], "p": 1e5}')
+    T = conds.get("T")
+    if T is None:
+        raise ServeError(E_BAD_REQUEST, "/conditions/T: missing")
+    T = [float(t) for t in (T if isinstance(T, (list, tuple)) else [T])]
+    if not T:
+        raise ServeError(E_BAD_REQUEST, "/conditions/T: empty grid")
+    p = conds.get("p", 1.0e5)
+    p = [float(v) for v in (p if isinstance(p, (list, tuple))
+                            else [p] * len(T))]
+    if len(p) != len(T):
+        raise ServeError(E_BAD_REQUEST,
+                         f"/conditions/p: {len(p)} values for "
+                         f"{len(T)} temperatures")
+    tof_terms = payload.get("tof_terms")
+    if tof_terms is not None and not isinstance(tof_terms, (list, tuple)):
+        raise ServeError(E_BAD_REQUEST, "/tof_terms: expected a list")
+    cls = payload.get("deadline_class", "standard")
+    wait = payload.get("wait_budget_s")
+    if wait is not None:
+        wait = float(wait)
+        if wait < 0:
+            raise ServeError(E_BAD_REQUEST,
+                             "/wait_budget_s: must be >= 0")
+    want = payload.get("return", ())
+    if not isinstance(want, (list, tuple)):
+        raise ServeError(E_BAD_REQUEST, "/return: expected a list of "
+                         "result keys (e.g. [\"y\"])")
+    return {"mechanism": mech, "T": T, "p": p,
+            "tof_terms": list(tof_terms) if tof_terms else None,
+            "deadline_class": str(cls), "wait_budget_s": wait,
+            "want": [str(k) for k in want]}

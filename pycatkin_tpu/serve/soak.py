@@ -1,0 +1,320 @@
+"""Soak harness: stream randomized synthetic mechanisms through a
+live :class:`serve.server.SweepServer` and report serving metrics in a
+BENCH-style JSON record (``tools/soak.py`` is the CLI; the bench smoke
+gate runs a miniature in-process soak).
+
+Phases:
+
+1. **pool** -- seed-deterministic mechanisms per requested ABI bucket
+   (:func:`models.synthetic.synthetic_system_for_bucket`), so the soak
+   controls pack occupancy bucket by bucket;
+2. **warm** -- the server's prewarm (solo zoo + packed executables per
+   k_bucket), then one streamed burst per bucket through the real
+   serving path; everything after :meth:`SweepServer.mark_warm` counts
+   against the zero-compile gate;
+3. **measure** -- ``n_requests`` concurrent sweeps, round-robin over
+   buckets, random mechanism + temperature grid per request; client-
+   side latency per request, response-schema presence audited;
+4. **drain burst** -- a final burst is submitted and the server is
+   drained WHILE they are pending: graceful drain must complete every
+   accepted request (no-loss proof).
+
+The resulting record carries ``serve.p50_s`` / ``serve.p99_s`` /
+``serve.zero_compile_rate`` / ``serve.mean_occupancy``, which
+``obs/history.py`` tracks with the same median±MAD sentinel as sweep
+throughput (tools/perfwatch.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+SCHEMA = "pycatkin-serve-soak/v1"
+
+# Response fields every ok sweep response must carry (acceptance:
+# manifest, telemetry and quarantine round-trip on EVERY response).
+REQUIRED_RESPONSE_FIELDS = ("result", "manifest", "lane_telemetry",
+                            "quarantine", "pack", "timing")
+
+
+def _audit_response(resp: dict) -> list:
+    """Names of required fields missing from an ok response.
+    ``lane_telemetry`` must be present but may be null (a runner that
+    produced none); everything else must be a real value."""
+    bad = [f for f in REQUIRED_RESPONSE_FIELDS
+           if f not in resp
+           or (resp[f] is None and f != "lane_telemetry")]
+    # Verdict arrays must arrive as real JSON lists, one entry per
+    # lane -- a serializer regression that ships reprs instead of
+    # values (e.g. an unhandled array type) is a schema violation,
+    # not a cosmetic one.
+    result = resp.get("result")
+    if isinstance(result, dict):
+        succ = result.get("success")
+        if not (isinstance(succ, list)
+                and len(succ) == resp.get("lanes")):
+            bad.append("result.success")
+    return bad
+
+
+def _percentile(values, q) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, dtype=float), q))
+
+
+async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
+                     lanes: int = 4, seed: int = 0,
+                     transport: str = "inproc",
+                     mechs_per_bucket: int = 6,
+                     max_occupancy: int = 8,
+                     concurrency: int = 16,
+                     runner: str = "inproc",
+                     aot_pack: Optional[str] = None,
+                     deadline_class: str = "standard",
+                     t_range=(480.0, 520.0),
+                     drain_burst: Optional[int] = None,
+                     verbose: bool = False) -> dict:
+    """Run the full soak against a fresh server; returns the BENCH
+    record. ``transport`` is ``"inproc"`` (direct handler calls,
+    mechanisms passed as built Systems) or ``"tcp"`` (full JSON wire
+    round-trip on localhost)."""
+    from ..models.synthetic import synthetic_system_for_bucket
+    from .client import SweepClient, TcpSweepClient
+    from .protocol import ServeConfig
+    from .server import SweepServer
+
+    rng = np.random.default_rng(seed)
+    t_wall0 = time.monotonic()
+
+    def say(msg):
+        if verbose:
+            print(f"soak: {msg}", flush=True)
+
+    # -- phase 1: mechanism pool --------------------------------------
+    say(f"building pool: {mechs_per_bucket} mechanisms x "
+        f"{len(buckets)} buckets")
+    pool = {b: [synthetic_system_for_bucket(
+                    b, seed=int(rng.integers(0, 2**31)))
+                for _ in range(mechs_per_bucket)]
+            for b in buckets}
+
+    cfg = ServeConfig(port=0, runner=runner, aot_pack=aot_pack,
+                      max_occupancy=max_occupancy)
+    server = await SweepServer(cfg).start(listen=(transport == "tcp"))
+    tcp = None
+    if transport == "tcp":
+        tcp = await TcpSweepClient("127.0.0.1", server.port).connect()
+        client = tcp
+    elif transport == "inproc":
+        client = SweepClient(server)
+    else:
+        raise ValueError(f"transport must be 'inproc' or 'tcp', "
+                         f"got {transport!r}")
+
+    def payload_mech(sim):
+        # TCP exercises the full wire schema; in-proc skips the JSON
+        # round-trip (the production embedding's fast path).
+        if transport == "tcp":
+            from ..utils.io import system_to_dict
+            return system_to_dict(sim)
+        return sim
+
+    def random_T():
+        return [float(t) for t in rng.uniform(*t_range, size=lanes)]
+
+    async def one_request(sim, sem, latencies, failures, violations):
+        async with sem:
+            t0 = time.monotonic()
+            resp = await client.sweep(payload_mech(sim), random_T(),
+                                      deadline_class=deadline_class)
+            dt = time.monotonic() - t0
+            if resp.get("ok"):
+                latencies.append(dt)
+                missing = _audit_response(resp)
+                if missing:
+                    violations.append({"id": resp.get("id"),
+                                       "missing": missing})
+            else:
+                failures.append(resp.get("error", {}))
+
+    try:
+        # -- phase 2: warm --------------------------------------------
+        say("prewarming (solo zoo + packed executables)")
+        k_buckets = sorted({1 << i for i in range(
+            max(1, max_occupancy).bit_length())} | {max_occupancy})
+        prewarm = await asyncio.to_thread(
+            server.warm, [pool[b][0] for b in buckets], lanes,
+            tuple(k for k in k_buckets if k > 1))
+        say(f"prewarm: {prewarm}")
+        warm_lat, warm_fail, warm_viol = [], [], []
+        sem = asyncio.Semaphore(concurrency)
+        warm_jobs = []
+        for b in buckets:
+            # One full burst (packs) plus one straggler (K=1 flush)
+            # per bucket, through the real serving path.
+            for i in range(max_occupancy):
+                warm_jobs.append(one_request(
+                    pool[b][i % len(pool[b])], sem, warm_lat,
+                    warm_fail, warm_viol))
+        await asyncio.gather(*warm_jobs)
+        for b in buckets:
+            await one_request(pool[b][0], sem, warm_lat, warm_fail,
+                              warm_viol)
+        server.mark_warm()
+        n_warmup = len(warm_lat) + len(warm_fail)
+        say(f"warmup done: {n_warmup} requests "
+            f"({len(warm_fail)} failed)")
+
+        # -- phase 3: measured stream ---------------------------------
+        latencies, failures, violations = [], [], []
+        jobs = []
+        for i in range(n_requests):
+            b = buckets[i % len(buckets)]
+            sim = pool[b][int(rng.integers(0, len(pool[b])))]
+            jobs.append(one_request(sim, sem, latencies, failures,
+                                    violations))
+        say(f"streaming {n_requests} measured requests "
+            f"(concurrency {concurrency})")
+        t_meas0 = time.monotonic()
+        await asyncio.gather(*jobs)
+        measure_s = time.monotonic() - t_meas0
+        say(f"measured phase: {measure_s:.1f}s, "
+            f"{len(failures)} failures")
+
+        # -- phase 4: drain burst (no-loss proof) ---------------------
+        nb = (len(buckets) * max_occupancy if drain_burst is None
+              else drain_burst)
+        burst_lat, burst_fail, burst_viol = [], [], []
+        burst = [one_request(pool[buckets[i % len(buckets)]][0], sem,
+                             burst_lat, burst_fail, burst_viol)
+                 for i in range(nb)]
+        completed0 = server.stats()["completed_total"]
+        burst_tasks = [asyncio.ensure_future(j) for j in burst]
+        # Drain only once every burst request is past admission (over
+        # TCP that takes a round-trip): the no-loss claim is about
+        # ACCEPTED requests, and draining earlier would just reject
+        # them at the door.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            done = server.stats()["completed_total"] - completed0
+            if done + server.in_service >= nb:
+                break
+            await asyncio.sleep(0.002)
+        drain_task = asyncio.ensure_future(server.drain())
+        await asyncio.gather(*burst_tasks)
+        await drain_task
+        stats = server.stats()
+        drain_burst_ok = (len(burst_lat) + len(burst_fail) == nb
+                          and not burst_fail and not burst_viol)
+        say(f"drain complete; burst ok={drain_burst_ok}")
+    finally:
+        if tcp is not None:
+            await tcp.close()
+        await server.stop()
+
+    backend = ((server.boot_manifest.get("backend") or {})
+               .get("platform")) or "cpu"
+    zero_rate = stats.get("zero_compile_rate_after_warm")
+    record = {
+        "bench": "serve-soak", "schema": SCHEMA,
+        "backend": backend, "transport": transport, "runner": runner,
+        "aot_pack": bool(aot_pack),
+        "n_requests": n_requests, "n_ok": len(latencies),
+        "n_failed": len(failures),
+        "n_warmup": n_warmup, "n_drain_burst": nb,
+        "buckets": list(buckets), "lanes": lanes,
+        "mechs_per_bucket": mechs_per_bucket,
+        "max_occupancy": max_occupancy, "concurrency": concurrency,
+        "seed": seed,
+        "schema_violations": len(violations) + len(warm_viol),
+        "warmup": {"prewarm": prewarm,
+                   "requests": n_warmup,
+                   "failed": len(warm_fail)},
+        "serve": {
+            "p50_s": _percentile(latencies, 50),
+            "p99_s": _percentile(latencies, 99),
+            "mean_s": (float(np.mean(latencies)) if latencies
+                       else None),
+            "throughput_rps": (len(latencies) / measure_s
+                               if measure_s > 0 else None),
+            "zero_compile_rate": zero_rate,
+            "mean_occupancy": stats.get("mean_occupancy"),
+            "flushes": stats.get("flushes"),
+            "flushes_after_warm": stats.get("flushes_after_warm"),
+            "compiles_after_warm": stats.get("compiles_after_warm"),
+            "rejects": stats.get("rejected_total"),
+            "drain_burst_ok": bool(drain_burst_ok),
+        },
+        "server_stats": stats,
+        "failures": failures[:10],
+        "violations": (violations + warm_viol)[:10],
+        "wall_s": time.monotonic() - t_wall0,
+        "manifest": server.boot_manifest,
+    }
+    return record
+
+
+def run_soak(out_path: Optional[str] = None, **kwargs) -> dict:
+    """Synchronous entry: force the ABI gate on (packing is the whole
+    point of the service), run :func:`soak_async`, optionally write
+    the record to ``out_path``."""
+    prev_abi = os.environ.get("PYCATKIN_ABI")
+    os.environ["PYCATKIN_ABI"] = "1"
+    try:
+        record = asyncio.run(soak_async(**kwargs))
+    finally:
+        if prev_abi is None:
+            os.environ.pop("PYCATKIN_ABI", None)
+        else:
+            os.environ["PYCATKIN_ABI"] = prev_abi
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
+def check_soak_record(record: dict, p99_budget_s: float = 30.0,
+                      expect_zero_compiles: bool = True,
+                      expect_warm_compiled_zero: bool = False) -> list:
+    """Gate a soak record; returns a list of failure strings (empty =
+    pass). The serve-check CI lane and ``bench.py --smoke`` both call
+    this, so the gate logic cannot drift between them."""
+    problems = []
+    serve = record.get("serve") or {}
+    if record.get("n_failed"):
+        problems.append(f"{record['n_failed']} measured requests "
+                        f"failed: {record.get('failures')}")
+    if record.get("n_ok") != record.get("n_requests"):
+        problems.append(f"only {record.get('n_ok')} of "
+                        f"{record.get('n_requests')} measured requests "
+                        f"returned ok")
+    if record.get("schema_violations"):
+        problems.append(f"{record['schema_violations']} responses "
+                        f"missing manifest/telemetry/quarantine: "
+                        f"{record.get('violations')}")
+    if expect_zero_compiles and serve.get("zero_compile_rate") != 1.0:
+        problems.append(f"zero-compile rate after warmup is "
+                        f"{serve.get('zero_compile_rate')} "
+                        f"(compiles_after_warm="
+                        f"{serve.get('compiles_after_warm')}), not 1.0")
+    p99 = serve.get("p99_s")
+    if p99 is None or p99 > p99_budget_s:
+        problems.append(f"p99 latency {p99}s over budget "
+                        f"{p99_budget_s}s")
+    if not serve.get("drain_burst_ok"):
+        problems.append("graceful drain lost or failed burst requests")
+    if (expect_warm_compiled_zero
+            and ((record.get("warmup") or {}).get("prewarm") or {})
+            .get("compiled") != 0):
+        problems.append(
+            f"pack-warmed boot still compiled "
+            f"{record['warmup']['prewarm'].get('compiled')} programs "
+            f"(AOT pack miss)")
+    return problems

@@ -40,8 +40,45 @@ def test_extract_metrics_unwraps_and_falls_back_to_ledger_mfu():
     assert hist.extract_metrics("garbage") == {}
 
 
+def test_extract_metrics_pulls_serve_slos_from_the_sub_object():
+    rec = {"backend": "cpu", "value": 10.0,
+           "serve": {"p50_s": 0.8, "p99_s": 2.5,
+                     "zero_compile_rate": 1.0, "mean_occupancy": 0.85,
+                     "throughput_rps": 3.0}}  # untracked key: ignored
+    m = hist.extract_metrics(rec)
+    assert m["serve_p50_s"] == 0.8 and m["serve_p99_s"] == 2.5
+    assert m["serve_zero_compile_rate"] == 1.0
+    assert m["serve_mean_occupancy"] == 0.85
+    assert "serve_throughput_rps" not in m
+    # Explicit top-level serve_* wins over the sub-object fallback,
+    # and a record with no serve sub-object simply lacks the metrics.
+    both = hist.extract_metrics({"serve_p99_s": 9.0,
+                                 "serve": {"p99_s": 1.0}})
+    assert both["serve_p99_s"] == 9.0
+    assert "serve_p99_s" not in hist.extract_metrics({"value": 1.0})
+    # A non-dict serve field must not crash the ingest.
+    assert "serve_p99_s" not in hist.extract_metrics({"serve": "gone"})
+
+
 def _entries(values, metric="value"):
     return [{"metrics": {metric: v}} for v in values]
+
+
+def test_flag_regressions_on_serve_slos():
+    history = _entries([2.0, 2.2, 1.9, 2.1, 2.0], metric="serve_p99_s")
+    cand = {"serve": {"p99_s": 6.0}}
+    found = hist.flag_regressions(history, cand)
+    assert [f["metric"] for f in found] == ["serve_p99_s"]
+    assert found[0]["direction"] == "lower"
+    # Faster tail latency is an improvement, never a finding.
+    assert hist.flag_regressions(history, {"serve": {"p99_s": 1.0}}) == []
+    # zero_compile_rate is higher-is-better: a warm serving path that
+    # starts compiling again IS a regression.
+    rate = _entries([1.0] * 5, metric="serve_zero_compile_rate")
+    assert hist.flag_regressions(
+        rate, {"serve": {"zero_compile_rate": 0.5}})
+    assert hist.flag_regressions(
+        rate, {"serve": {"zero_compile_rate": 1.0}}) == []
 
 
 def test_flag_regressions_noise_band_and_direction():

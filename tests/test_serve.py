@@ -1,0 +1,375 @@
+"""The serving layer (docs/serving.md): protocol, admission, packing,
+drain, and the coalescer's external-scheduler contract.
+
+The acceptance surface, smallest-first: request parsing names the
+offending field JSON-pointer style; admission control answers
+structured rejects (overloaded / draining / bad request) instead of
+dropping connections; a sweep response always carries result +
+manifest + lane telemetry + quarantine + pack + timing; two
+same-bucket requests ride ONE packed flush; drain loses nothing even
+with a concurrent burst in flight; and the coalescer's queue-only mode
+(``autoflush=False`` + ``take_group``/``run_requests``) survives the
+edge cases a serving loop actually hits -- a request due EXACTLY at
+its deadline, ``flush_all`` racing a caller-forced ``result()``, and a
+clock that moves backwards.
+
+Solver-bearing tests share one bucket-16 mechanism pair at 2 lanes so
+the program zoo compiles once for the module.
+"""
+
+import asyncio
+import types
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.models.synthetic import synthetic_system_for_bucket
+from pycatkin_tpu.parallel.dispatch import SweepCoalescer
+from pycatkin_tpu.serve import (DEADLINE_CLASSES, ServeConfig,
+                                ServeError, SweepClient, TcpSweepClient)
+from pycatkin_tpu.serve.protocol import (E_BAD_REQUEST, E_DRAINING,
+                                         E_OVERLOADED,
+                                         parse_sweep_request)
+from pycatkin_tpu.serve.server import SweepServer
+from pycatkin_tpu.utils.io import system_to_dict
+
+N_LANES = 2
+T_GRID = [500.0, 520.0]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def abi_on():
+    mp = pytest.MonkeyPatch()
+    mp.setenv(abi.ABI_ENV, "1")
+    yield
+    mp.undo()
+
+
+@pytest.fixture(scope="module")
+def sims():
+    return [synthetic_system_for_bucket(16, seed=s) for s in (0, 1)]
+
+
+# -- the soak's bucket-targeted mechanism generator --------------------
+
+
+def test_bucket_generator_lands_in_bucket_seed_invariantly(sims):
+    for bucket in (16, 32, 128):
+        fps = set()
+        for seed in (0, 7):
+            sim = (sims[0] if bucket == 16 and seed == 0
+                   else synthetic_system_for_bucket(bucket, seed=seed))
+            static = abi.select_static(sim.spec)
+            assert static.n_species == bucket
+            fps.add(abi.abi_fingerprint_of(static))
+        # One fingerprint per bucket across seeds: co-packability is
+        # the generator's whole contract.
+        assert len(fps) == 1
+
+
+def test_bucket_generator_rejects_with_the_reason_named():
+    with pytest.raises(ValueError, match="not an ABI bucket"):
+        synthetic_system_for_bucket(20)
+    # A species count whose lowered shape (TS states included) cannot
+    # land in the requested bucket names where it WOULD land.
+    with pytest.raises(ValueError, match="bucket"):
+        synthetic_system_for_bucket(16, n_species=60, n_reactions=40)
+    with pytest.raises(ValueError):
+        synthetic_system_for_bucket(32, n_species=4)
+
+
+# -- protocol ----------------------------------------------------------
+
+
+def test_parse_sweep_request_names_the_offending_field():
+    cases = [
+        ({}, "/mechanism"),
+        ({"mechanism": {}}, "/conditions"),
+        ({"mechanism": {}, "conditions": {}}, "/conditions/T"),
+        ({"mechanism": {}, "conditions": {"T": []}}, "/conditions/T"),
+        ({"mechanism": {}, "conditions": {"T": [1, 2], "p": [1]}},
+         "/conditions/p"),
+        ({"mechanism": {}, "conditions": {"T": 500},
+          "tof_terms": "r1"}, "/tof_terms"),
+        ({"mechanism": {}, "conditions": {"T": 500},
+          "wait_budget_s": -1}, "/wait_budget_s"),
+        ({"mechanism": {}, "conditions": {"T": 500}, "return": "y"},
+         "/return"),
+    ]
+    for payload, field in cases:
+        with pytest.raises(ServeError) as exc:
+            parse_sweep_request(payload)
+        assert exc.value.code == E_BAD_REQUEST
+        assert field in str(exc.value), payload
+    # Scalars broadcast: one T, scalar p, defaults for the rest.
+    parsed = parse_sweep_request(
+        {"mechanism": {}, "conditions": {"T": 500}})
+    assert parsed["T"] == [500.0] and parsed["p"] == [1.0e5]
+    assert parsed["deadline_class"] == "standard"
+
+
+def test_serve_config_resolves_env_and_validates(monkeypatch):
+    monkeypatch.setenv("PYCATKIN_SERVE_MAX_PENDING", "7")
+    monkeypatch.setenv("PYCATKIN_SERVE_BUDGET_BATCH", "9.5")
+    cfg = ServeConfig()
+    assert cfg.max_pending == 7
+    assert cfg.wait_budget_for("batch") == 9.5
+    assert set(DEADLINE_CLASSES) == {"interactive", "standard", "batch"}
+    # Interactive < standard < batch: the SLA ordering is the point.
+    assert (cfg.wait_budget_for("interactive")
+            < cfg.wait_budget_for("standard"))
+    with pytest.raises(ServeError) as exc:
+        cfg.wait_budget_for("realtime")
+    assert exc.value.code == E_BAD_REQUEST
+    with pytest.raises(ValueError):
+        ServeConfig(runner="bogus")
+    with pytest.raises(ValueError):
+        ServeConfig(max_pending=0)
+
+
+# -- admission control -------------------------------------------------
+
+
+def test_admission_rejects_are_structured_responses():
+    async def scenario():
+        server = await SweepServer(ServeConfig()).start(listen=False)
+        try:
+            pong = await SweepClient(server).ping()
+            assert pong["ok"] and pong["pong"]
+
+            bad = await server.handle({"op": "conjure", "id": 3})
+            assert not bad["ok"] and bad["id"] == 3
+            assert bad["error"]["code"] == E_BAD_REQUEST
+
+            bad = await server.handle({"op": "sweep", "id": 4})
+            assert not bad["ok"]
+            assert bad["error"]["code"] == E_BAD_REQUEST
+            assert "/mechanism" in bad["error"]["message"]
+
+            # Full pending queue: structured overload, not a hang.
+            server.config.max_pending = 1
+            server._taken = 5  # simulate a deep in-flush backlog
+            busy = await server.handle(
+                {"op": "sweep", "id": 5, "mechanism": {},
+                 "conditions": {"T": 500}})
+            server._taken = 0
+            assert busy["error"]["code"] == E_OVERLOADED
+
+            server._draining = True
+            no = await server.handle(
+                {"op": "sweep", "id": 6, "mechanism": {},
+                 "conditions": {"T": 500}})
+            server._draining = False
+            assert no["error"]["code"] == E_DRAINING
+
+            stats = (await SweepClient(server).stats())["stats"]
+            assert stats["rejected_total"] == 4
+            assert stats["requests_total"] == 3  # sweeps that got in
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+# -- sweep round trip --------------------------------------------------
+
+RESPONSE_FIELDS = ("result", "manifest", "lane_telemetry",
+                   "quarantine", "pack", "timing")
+
+
+def _assert_response_schema(resp):
+    assert resp["ok"], resp.get("error")
+    for field in RESPONSE_FIELDS:
+        assert field in resp, f"response missing {field!r}"
+    assert resp["lanes"] == N_LANES
+    assert len(resp["result"]["success"]) == N_LANES
+    assert resp["quarantine"]["count"] == 0
+    assert resp["manifest"]["abi"]["fingerprint"]
+    assert {"total_s", "solve_s", "queue_s"} <= set(resp["timing"])
+
+
+def test_two_same_bucket_requests_ride_one_packed_flush(sims):
+    async def scenario():
+        server = await SweepServer(ServeConfig()).start(listen=False)
+        try:
+            client = SweepClient(server)
+            resps = await asyncio.gather(*(
+                client.sweep(sim, T_GRID, tof_terms=[last_rname(sim)],
+                             wait_budget_s=0.5, want=["y"])
+                for sim in sims))
+            for resp in resps:
+                _assert_response_schema(resp)
+                assert resp["manifest"]["abi"]["packed"]
+                assert resp["pack"]["tenants"] == 2
+                assert resp["pack"]["occupancy"] == 1.0
+                assert len(resp["result"]["tof"]) == N_LANES
+                assert len(resp["result"]["y"]) == N_LANES
+            # Same flush, bitwise-identical telemetry framing.
+            assert (resps[0]["pack"]["flush_seq"]
+                    == resps[1]["pack"]["flush_seq"])
+            stats = server.stats()
+            assert stats["completed_total"] == 2
+            assert stats["flushes"] == 1
+            assert stats["mean_occupancy"] == 1.0
+        finally:
+            await server.drain()
+
+    asyncio.run(scenario())
+
+
+def last_rname(sim):
+    return sim.spec.rnames[-1]
+
+
+def test_tcp_round_trip_and_drain_loses_nothing(sims):
+    async def scenario():
+        server = await SweepServer(ServeConfig(port=0)).start()
+        client = await TcpSweepClient("127.0.0.1",
+                                      server.port).connect()
+        try:
+            assert (await client.ping())["pong"]
+            # Wire-schema mechanisms: the reference input-file dict.
+            mechs = [system_to_dict(s) for s in sims]
+            burst = [asyncio.ensure_future(
+                client.sweep(m, T_GRID, wait_budget_s=0.2))
+                for m in mechs for _ in range(2)]
+            # Admit the whole burst, then drain while it is in
+            # flight: nothing may be dropped on the floor.
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while (server.in_service + server.stats()["completed_total"]
+                   < len(burst)):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "burst never reached admission"
+                await asyncio.sleep(0.005)
+            drainer = asyncio.ensure_future(server.drain())
+            resps = await asyncio.gather(*burst)
+            await drainer
+            ok = [r for r in resps if r.get("ok")]
+            rejected = [r for r in resps if not r.get("ok")]
+            assert ok, "drain failed every burst request"
+            for r in ok:
+                _assert_response_schema(r)
+            for r in rejected:  # the only acceptable loss mode
+                assert r["error"]["code"] == E_DRAINING
+            assert len(ok) + len(rejected) == len(burst)
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_elastic_runner_policy_is_wired():
+    async def scenario():
+        server = SweepServer(ServeConfig(runner="elastic"))
+        co = server._make_coalescer()
+        try:
+            from pycatkin_tpu.parallel.dispatch import \
+                _default_packed_runner
+            assert co.runner is not _default_packed_runner
+            assert not co.autoflush
+            assert co.work_dir  # elastic runner shares an events file
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+# -- coalescer edge cases (the external-scheduler contract) ------------
+
+
+def _stub_coalescer(calls, **kwargs):
+    def runner(sims, conds_list, masks, x0s, **kw):
+        calls.append(len(sims))
+        return [{"success": np.ones(N_LANES, bool)} for _ in sims]
+
+    kwargs.setdefault("max_occupancy", 8)
+    kwargs.setdefault("max_wait_s", 1e9)
+    return SweepCoalescer(runner=runner, autoflush=False, **kwargs)
+
+
+def _fake_request():
+    sim = types.SimpleNamespace()  # unfittable -> solo group
+    conds = types.SimpleNamespace(T=np.linspace(450.0, 550.0, N_LANES))
+    return sim, conds
+
+
+def test_coalescer_request_due_exactly_at_its_deadline():
+    calls = []
+    co = _stub_coalescer(calls)
+    sim, conds = _fake_request()
+    req = co.submit(sim, conds, wait_budget_s=5.0)
+    deadline = req.submitted_at + 5.0
+    # A hair early: not due. At the deadline, to the bit: due.
+    assert co.due_keys(now=deadline - 1e-9) == []
+    assert co.poll(now=deadline - 1e-9) == 0
+    assert co.due_keys(now=deadline) == [req.group_key]
+    assert co.poll(now=deadline) == 1
+    assert req.done and calls == [1] and co.pending == 0
+
+
+def test_coalescer_backwards_clock_reports_nothing_due():
+    calls = []
+    co = _stub_coalescer(calls)
+    sim, conds = _fake_request()
+    req = co.submit(sim, conds, wait_budget_s=0.0)
+    # wait_budget_s=0 means due NOW -- but a clock that moved
+    # backwards must not flush (or crash) anything early.
+    past = req.submitted_at - 3600.0
+    assert co.due_keys(now=past) == []
+    assert co.poll(now=past) == 0
+    assert not req.done and co.pending == 1
+    assert co.poll(now=req.submitted_at) == 1
+    assert req.done
+
+
+def test_coalescer_flush_all_racing_forced_result():
+    calls = []
+    co = _stub_coalescer(calls)
+    sim, conds = _fake_request()
+    req = co.submit(sim, conds)
+    out = req.result()               # caller-forced flush wins
+    assert out["success"].all() and calls == [1]
+    assert co.flush_all() == 0       # the loser sees an empty queue
+    assert calls == [1]              # and never re-runs the group
+
+    req2 = co.submit(*_fake_request())
+    assert co.flush_all() == 1       # scheduler-side flush wins
+    assert req2.result()["success"].all()
+    assert calls == [1, 1]           # result() returned the cache
+    # The benign half of the take race: an already-taken key is [].
+    assert co.take_group(req2.group_key) == []
+
+
+def test_coalescer_solo_keys_never_alias():
+    calls = []
+    co = _stub_coalescer(calls)
+    sim, conds = _fake_request()
+    # Same unfittable sim submitted twice: two DISTINCT solo groups
+    # (id(sim) is reusable after GC; the monotonic counter is not).
+    r1 = co.submit(sim, conds)
+    r2 = co.submit(sim, conds)
+    assert r1.group_key != r2.group_key
+    assert r1.group_key[0] == "solo" and r2.group_key[0] == "solo"
+    assert co.pending == 2 and len(co._groups) == 2
+    co.flush_all()
+    assert calls == [1, 1]           # never co-flushed
+
+
+def test_coalescer_take_group_limit_requeues_remainder():
+    calls = []
+    co = _stub_coalescer(calls)
+    co._group_key = lambda *a, **k: ("fp", N_LANES, False, False)
+    reqs = [co.submit(*_fake_request(), wait_budget_s=b)
+            for b in (10.0, 4.0, 7.0)]
+    key = reqs[0].group_key
+    taken = co.take_group(key, limit=2)
+    assert taken == reqs[:2] and co.pending == 1
+    # The remainder's deadline is recomputed from ITS members only.
+    assert co._deadlines[key] == pytest.approx(
+        reqs[2].submitted_at + 7.0)
+    co.run_requests(key, taken)
+    assert reqs[0].done and reqs[1].done and not reqs[2].done
+    co.flush_all()
+    assert reqs[2].done and calls == [2, 1]

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Soak the sweep service (pycatkin_tpu/serve) and gate its SLOs.
+
+Streams randomized synthetic mechanisms through a live server
+(``serve/soak.py``) and writes a BENCH-style JSON record carrying
+p50/p99 latency, achieved pack occupancy and the post-warmup
+zero-compile rate -- metrics ``tools/perfwatch.py`` baselines with the
+same median±MAD sentinel as sweep throughput.
+
+Usage::
+
+    python tools/soak.py [--n 1000] [--buckets 16,32,128] [--tcp]
+                         [--json OUT.json] [--gate] [...]
+    python tools/soak.py --check        # the `make serve-check` lane
+
+``--check`` is the CI proof in two fresh processes: process 1 runs a
+small soak against an empty AOT cache and exports the warmed cache as
+a pack; process 2 boots its server FROM that pack (compile count of
+its prewarm must be zero), streams N~64 requests, and gates on a 100%
+zero-compile rate, the p99 budget, response manifest/telemetry
+presence, and loss-free drain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _parse_buckets(text: str):
+    return tuple(int(b) for b in text.split(",") if b.strip())
+
+
+def _run(args) -> int:
+    from pycatkin_tpu.serve.soak import check_soak_record, run_soak
+
+    record = run_soak(
+        out_path=args.json,
+        n_requests=args.n, buckets=_parse_buckets(args.buckets),
+        lanes=args.lanes, seed=args.seed,
+        transport="tcp" if args.tcp else "inproc",
+        mechs_per_bucket=args.mechs_per_bucket,
+        max_occupancy=args.max_occupancy,
+        concurrency=args.concurrency, runner=args.runner,
+        aot_pack=args.aot_pack, verbose=args.verbose)
+    if args.export_pack:
+        from pycatkin_tpu.parallel import compile_pool
+        stats = compile_pool.export_cache_pack(args.export_pack)
+        print(f"soak: exported AOT pack {args.export_pack} "
+              f"({stats['entries']} entries)", file=sys.stderr)
+    serve = record.get("serve") or {}
+    print(json.dumps(record if args.full_json else {
+        "bench": record["bench"], "backend": record["backend"],
+        "n_requests": record["n_requests"], "n_ok": record["n_ok"],
+        "serve": serve, "wall_s": record["wall_s"]}, indent=2))
+    if args.gate or args.expect_warm_compiled_zero:
+        problems = check_soak_record(
+            record, p99_budget_s=args.p99_budget,
+            expect_warm_compiled_zero=args.expect_warm_compiled_zero)
+        for p in problems:
+            print(f"soak: GATE FAIL -- {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("soak: gate OK", file=sys.stderr)
+    return 0
+
+
+def _cmd_check(args) -> int:
+    """Two-process pack-boot proof; see module docstring."""
+    me = os.path.abspath(__file__)
+    with tempfile.TemporaryDirectory(prefix="pycatkin_soak_") as td:
+        cache = os.path.join(td, "aot_cache")
+        pack = os.path.join(td, "serve_pack.tar.gz")
+        out = os.path.join(td, "soak.json")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYCATKIN_AOT_CACHE"] = cache
+        common = ["--buckets", args.buckets, "--lanes",
+                  str(args.lanes), "--max-occupancy",
+                  str(args.max_occupancy), "--seed", str(args.seed)]
+        warm_cmd = [sys.executable, me, "--n", "12",
+                    "--mechs-per-bucket", "2",
+                    "--export-pack", pack] + common
+        print("serve-check: [1/2] warming cache + exporting pack",
+              flush=True)
+        r = subprocess.run(warm_cmd, env=env)
+        if r.returncode != 0:
+            print("serve-check: FAIL -- warm/export run failed",
+                  file=sys.stderr)
+            return 1
+        # Fresh process + fresh cache dir: every warm executable must
+        # come from the pack, not from this process's compiles.
+        env2 = dict(env)
+        env2["PYCATKIN_AOT_CACHE"] = os.path.join(td, "aot_cache2")
+        check_cmd = [sys.executable, me, "--n", str(args.n),
+                     "--mechs-per-bucket", "2", "--tcp",
+                     "--aot-pack", pack, "--gate",
+                     "--expect-warm-compiled-zero",
+                     "--p99-budget", str(args.p99_budget),
+                     "--json", out] + common
+        print(f"serve-check: [2/2] pack-booted soak (n={args.n}, tcp)",
+              flush=True)
+        r = subprocess.run(check_cmd, env=env2)
+        if r.returncode != 0:
+            print("serve-check: FAIL -- gated soak failed",
+                  file=sys.stderr)
+            return 1
+        with open(out) as fh:
+            serve = (json.load(fh).get("serve") or {})
+        print(f"serve-check: OK -- p50={serve.get('p50_s'):.3f}s "
+              f"p99={serve.get('p99_s'):.3f}s "
+              f"zero_compile_rate={serve.get('zero_compile_rate')} "
+              f"mean_occupancy={serve.get('mean_occupancy'):.2f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="two-process pack-boot CI gate")
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--buckets", default="16,32,128")
+    ap.add_argument("--lanes", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tcp", action="store_true",
+                    help="full wire round-trip (default: in-process)")
+    ap.add_argument("--mechs-per-bucket", type=int, default=6)
+    ap.add_argument("--max-occupancy", type=int, default=8)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--runner", choices=("inproc", "elastic"),
+                    default="inproc")
+    ap.add_argument("--aot-pack", default=None,
+                    help="boot the server from this AOT cache pack")
+    ap.add_argument("--export-pack", default=None,
+                    help="export the AOT cache as a pack afterwards")
+    ap.add_argument("--json", default=None,
+                    help="write the full record to this path")
+    ap.add_argument("--full-json", action="store_true",
+                    help="print the full record, not the summary")
+    ap.add_argument("--gate", action="store_true",
+                    help="apply the SLO gate; nonzero exit on failure")
+    ap.add_argument("--p99-budget", type=float, default=30.0)
+    ap.add_argument("--expect-warm-compiled-zero", action="store_true",
+                    help="gate: prewarm must compile nothing "
+                         "(pack-booted server)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if args.check:
+        args.n = args.n if args.n != 1000 else 64
+        return _cmd_check(args)
+    return _run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
