@@ -5,8 +5,15 @@
 # selection). `lint` runs the unified pclint static-analysis pass
 # (docs/static_analysis.md): host-sync budget (PCL001), fault-site
 # registry (PCL002), jit purity (PCL003), tracer hygiene (PCL004),
-# dtype policy (PCL005) and the env-var registry (PCL006);
-# `lint-syncs`/`lint-faults` remain as single-rule aliases.
+# dtype policy (PCL005), the env-var registry (PCL006), async-blocking
+# (PCL010), lock discipline (PCL011), atomic-write protocol (PCL012)
+# and the cross-module fused-tail integrity rule (PCL013);
+# `lint-syncs`/`lint-faults` remain as single-rule aliases. Results are
+# cached in .pclint_cache/ (content-addressed; `--no-cache` bypasses).
+# `test-san` is the sanitizer lane (pcsan, docs/static_analysis.md):
+# the tripwire selftests plus the sync-budget and serve suites re-run
+# with PYCATKIN_SAN=1, so the recompile/sync/stall tripwires ride the
+# real code paths armed.
 # `bench-smoke` is the end-to-end canary: pclint plus an 8x8 CPU sweep
 # with prewarm that fails on any crash, any new lint finding, a prewarm
 # layout over the program budget (<= 10), or a clean sweep spending
@@ -37,9 +44,9 @@
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test test-faults test-validate test-sharded test-all lint \
-	lint-faults lint-syncs lint-baseline bench-smoke aot-pack-selftest \
-	obs-check perfwatch chaos serve-check
+.PHONY: test test-faults test-validate test-sharded test-san test-all \
+	lint lint-faults lint-syncs lint-baseline bench-smoke \
+	aot-pack-selftest obs-check perfwatch chaos serve-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -57,6 +64,13 @@ test-sharded:
 
 test-faults:
 	$(PYTEST) -m faults
+
+# Sanitizer lane: tripwire selftests, then the budget/serve suites with
+# every pcsan tripwire armed (PYCATKIN_SAN=1) over the real paths.
+test-san:
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python -m pytest \
+		tests/test_san.py tests/test_sync_budget.py \
+		tests/test_serve.py -q -p no:cacheprovider
 
 test-validate:
 	$(PYTEST) -m validate

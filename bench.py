@@ -742,9 +742,11 @@ def smoke_main():
     any new lint finding, any crash, a clean sweep spending more
     than 2 counted host syncs (the fused single-dispatch tail spends
     exactly 1), a prewarmed program missing its cost-ledger row, a
-    sweep output missing its per-lane telemetry bundle, or a breach of
+    sweep output missing its per-lane telemetry bundle, a breach of
     the packed multi-tenant contracts (zero marginal compiles, one
-    sync, bitwise-vs-solo; ``packed_ok``) -- the cheap
+    sync, bitwise-vs-solo; ``packed_ok``), or any pcsan runtime
+    tripwire firing on the sanitizer-guarded re-run (``san_ok``) -- the
+    cheap
     end-to-end canary that the correctness gates and the pipelined
     executor survive integration, not a throughput record. Prints
     exactly one JSON line."""
@@ -897,6 +899,56 @@ def smoke_main():
             serve_problems = [f"serve soak crashed: {e}"]
         serve = serve_rec.get("serve") or {}
         serve_ok = not serve_problems
+
+        # Sanitizer gate (ISSUE-14, pcsan): the same 8x8 sweep once
+        # more with all three runtime tripwires armed -- recompile
+        # (one recording pass, then mark_warm: a warm cell must
+        # dispatch zero fresh programs), strict sync region at the
+        # budget (an uncounted device pull raises at the pull site),
+        # and the event-loop stall watchdog around an armed loop that
+        # offloads the sweep to a worker thread (the serve idiom: the
+        # loop itself must never block). Any trip hard-fails the lane.
+        import asyncio as _asyncio
+
+        from pycatkin_tpu import san as _san
+        from pycatkin_tpu.san import recompile as _san_recompile
+        from pycatkin_tpu.san import stall as _san_stall
+        from pycatkin_tpu.san import syncs as _san_syncs
+        san_err = None
+        prev_san = os.environ.get(_san.ENV)
+        os.environ[_san.ENV] = "1"
+
+        async def _guarded_sweep():
+            await _san_stall.arm()
+            with _san_syncs.strict(budget=max_syncs,
+                                   label="san smoke sweep"):
+                # to_thread copies the context, so the strict region
+                # follows the sweep onto the worker thread while the
+                # armed loop stays free to detect stalls.
+                return await _asyncio.to_thread(
+                    sweep_steady_state, spec, conds,
+                    tof_mask=mask, check_stability=True)
+
+        try:
+            _san_recompile.reset()
+            _san_recompile.activate()
+            sweep_steady_state(spec, conds, tof_mask=mask,
+                               check_stability=True)   # records keys
+            _san_recompile.mark_warm()
+            with _san_stall.watchdog():
+                out_san = _asyncio.run(_guarded_sweep())
+            if not bool(np.all(np.asarray(out_san["success"]))):
+                san_err = "sweep under sanitizers lost lanes"
+        except _san.SanError as e:
+            san_err = str(e)
+        finally:
+            _san_recompile.deactivate()
+            _san_recompile.reset()
+            if prev_san is None:
+                os.environ.pop(_san.ENV, None)
+            else:
+                os.environ[_san.ENV] = prev_san
+        san_ok = san_err is None
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -1030,6 +1082,8 @@ def smoke_main():
         "packed_ok": packed_ok,
         "serve": serve,
         "serve_ok": serve_ok,
+        "san_ok": san_ok,
+        "san_error": san_err,
         "lint_ok": True,
         "lint_findings": 0,
         "trace_ok": trace_ok,
@@ -1095,6 +1149,9 @@ def smoke_main():
     if not serve_ok:
         log(f"bench-smoke: FAIL -- serve gate: "
             f"{'; '.join(serve_problems)}")
+        return 1
+    if not san_ok:
+        log(f"bench-smoke: FAIL -- sanitizer gate (pcsan): {san_err}")
         return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "

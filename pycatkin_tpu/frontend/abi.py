@@ -140,7 +140,8 @@ def _bucket_for(n: int, buckets) -> int | None:
     return None
 
 
-def select_static(spec: ModelSpec, species_bucket: int | None = None,
+def select_static(spec: ModelSpec,  # pclint: disable=PCL013 -- host-side spec metadata; asarray touches numpy index arrays, no device round trip
+                  species_bucket: int | None = None,
                   reaction_bucket: int | None = None) -> AbiStatic:
     """Pick the bucket for ``spec`` (or validate a forced one), raising
     :class:`AbiBucketError` with a per-dimension diagnostic when the

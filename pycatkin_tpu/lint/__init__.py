@@ -27,12 +27,30 @@ PCL008    event-kinds       every record_event kind documented in
 PCL009    metric-names      every metric name emitted via obs.metrics
                             documented in the docs/observability.md
                             metrics catalog
+PCL010    async-blocking    no blocking calls (time.sleep, sync I/O,
+                            future.result, device pulls) lexically
+                            inside serve/ ``async def`` bodies;
+                            asyncio.to_thread / run_in_executor are the
+                            sanctioned offloads
+PCL011    lock-discipline   attributes declared ``# guarded-by: <lock>``
+                            are only touched inside ``with <lock>:``
+                            in their class's methods
+PCL012    atomic-write      no bare ``open(..., "w")`` / ``os.rename``
+                            in the journal/scheduler protocol files;
+                            publish via tmp + ``os.replace`` /
+                            ``os.link`` / ``O_EXCL``
+PCL013    fused-tail        cross-module: every function reachable from
+                            the fused/packed sweep bodies (ProjectIndex
+                            call graph) that materializes device values
+                            is ``@hotpath``-decorated
 ========  ================  =============================================
 
 Suppressions: inline ``# pclint: disable=<rule> -- <reason>`` (any line
 of the flagged span) or the committed ``lint_baseline.json``
-(:mod:`pycatkin_tpu.lint.baseline`). Full docs:
-``docs/static_analysis.md``.
+(:mod:`pycatkin_tpu.lint.baseline`). Results are cached content-
+addressed in ``.pclint_cache/`` (:mod:`pycatkin_tpu.lint.cache`;
+``--no-cache`` bypasses). Full docs: ``docs/static_analysis.md``; the
+runtime companions (pcsan sanitizers) live in :mod:`pycatkin_tpu.san`.
 """
 
 from __future__ import annotations

@@ -1,0 +1,138 @@
+"""PCL010 async-blocking: nothing blocks the serve event loop.
+
+The serving layer (``pycatkin_tpu/serve``) is ONE asyncio loop; a
+single blocking call inside an ``async def`` stalls every in-flight
+request behind it (the SLA-aware flush deadlines of the coalescer are
+only as good as the loop's tick). The sanctioned idiom is offload:
+``await asyncio.to_thread(...)`` / ``loop.run_in_executor(...)`` --
+passing a blocking CALLABLE is fine, CALLING it on the loop is not.
+
+Flagged lexically inside ``async def`` bodies (nested sync ``def``
+bodies excluded -- they execute wherever they are invoked, which for
+the serve tree is a worker thread):
+
+- ``time.sleep`` (use ``asyncio.sleep``);
+- blocking file I/O: builtin ``open`` (offload it);
+- blocking process/socket construction: ``subprocess.run/call/
+  check_output/check_call/Popen``, ``socket.create_connection``,
+  ``urllib.request.urlopen``, ``os.system``;
+- future/thread joins: ``.result()`` / ``.join()`` method calls
+  (``concurrent.futures`` results and thread joins block; await the
+  asyncio future instead);
+- host-sync pulls: ``host_sync(...)``, ``jax.device_get``,
+  ``np.asarray`` (a device materialization parks the loop for a full
+  tunnel round trip -- the worst offender of all).
+
+The runtime counterpart is the event-loop stall sanitizer
+(:mod:`pycatkin_tpu.san.stall`), which catches what escapes the
+lexical net (docs/static_analysis.md "Sanitizers").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+# (module-ish base, attr) calls that block.
+_BLOCKING_ATTR_CALLS = {
+    ("time", "sleep"): "time.sleep blocks the loop; use asyncio.sleep",
+    ("os", "system"): "os.system blocks the loop; offload via "
+                      "asyncio.to_thread",
+    ("subprocess", "run"): "subprocess.run blocks the loop",
+    ("subprocess", "call"): "subprocess.call blocks the loop",
+    ("subprocess", "check_output"): "subprocess.check_output blocks "
+                                    "the loop",
+    ("subprocess", "check_call"): "subprocess.check_call blocks the "
+                                  "loop",
+    ("socket", "create_connection"): "blocking socket connect; use "
+                                     "asyncio.open_connection",
+    ("jax", "device_get"): "device->host pull on the event loop; "
+                           "offload the sweep to a worker thread",
+    ("np", "asarray"): "np.asarray may materialize a device array on "
+                       "the loop; offload it",
+}
+
+# Bare-name calls that block.
+_BLOCKING_NAME_CALLS = {
+    "open": "blocking file I/O on the event loop; offload via "
+            "asyncio.to_thread",
+    "host_sync": "counted host sync on the event loop; offload the "
+                 "sweep to a worker thread",
+    "input": "blocking stdin read on the event loop",
+}
+
+# Method attrs that block regardless of receiver.
+_BLOCKING_METHODS = {
+    "result": ".result() blocks the loop; await the asyncio future",
+    "join": ".join() blocks the loop; offload via asyncio.to_thread",
+}
+
+
+def _attr_base(f: ast.Attribute):
+    return f.value.id if isinstance(f.value, ast.Name) else None
+
+
+def _blocking_reason(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return _BLOCKING_NAME_CALLS.get(f.id)
+    if isinstance(f, ast.Attribute):
+        base = _attr_base(f)
+        if base is not None:
+            hit = _BLOCKING_ATTR_CALLS.get((base, f.attr))
+            if hit is not None:
+                return hit
+            if base in ("str", "os", "path", "json"):
+                return None          # common safe receivers
+        return _BLOCKING_METHODS.get(f.attr)
+    return None
+
+
+def _join_is_str(node: ast.Call) -> bool:
+    """``"sep".join(...)`` / ``sep.join(parts)`` string joins are not
+    thread joins: a literal-string receiver, or a single iterable
+    argument of strings, is the overwhelmingly common case -- only
+    no-arg ``x.join()`` (thread API) is unambiguous, so we flag
+    ``.join`` ONLY when called with no arguments."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "join"
+            and bool(node.args or node.keywords))
+
+
+@register
+class AsyncBlockingChecker(Checker):
+    rule = "PCL010"
+    name = "async-blocking"
+    description = ("blocking call (sleep/file/socket I/O, .result()/"
+                   ".join(), host-sync pull) lexically inside an "
+                   "async def in serve/; offload via asyncio.to_thread"
+                   "/run_in_executor")
+    scope = ("pycatkin_tpu/serve/",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._check_async(src, node)
+
+    def _check_async(self, src: SourceFile, fn: ast.AsyncFunctionDef):
+        yield from self._walk_body(src, fn, fn.body)
+
+    def _walk_body(self, src, fn, body):
+        for stmt in body:
+            yield from self._walk_node(src, fn, stmt)
+
+    def _walk_node(self, src, fn, node):
+        # Nested sync defs run off-loop (serve hands them to worker
+        # threads); nested async defs are checked by the outer walk.
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            reason = _blocking_reason(node)
+            if reason is not None and not _join_is_str(node):
+                yield self.finding(
+                    src, node,
+                    f"{reason} (inside `async def {fn.name}`)")
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk_node(src, fn, child)

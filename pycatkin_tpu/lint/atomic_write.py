@@ -1,0 +1,126 @@
+"""PCL012 atomic-write protocol: no torn files in the protocol dirs.
+
+The elastic scheduler's on-disk queue (``robustness/scheduler.py``) and
+the serialization layer (``utils/io.py``) are multi-process protocol
+surfaces: leases, done records, journals and checkpoints are read by
+concurrent workers, lease thieves and crash-recovery replays. The
+repo's established crash-atomic idioms are
+
+- tmp + ``os.replace`` for last-writer-wins records (``_write_json``,
+  ``atomic_save_results``);
+- tmp + ``os.link`` for first-writer-wins records (``claim``,
+  ``write_done`` -- hard-link create fails when the name exists, the
+  one portable O_EXCL-with-payload primitive);
+- append + flush + fsync with torn-tail repair for journals
+  (``append_json_line``).
+
+This rule flags, inside those two files only:
+
+- ``os.rename`` anywhere (silently clobbers on POSIX, fails on
+  Windows when the target exists; ``os.replace``/``os.link`` make the
+  intent explicit);
+- a bare ``open(path, "w"/"wb"/...)`` write in a function that never
+  publishes via ``os.replace``/``os.link`` -- a reader can observe the
+  half-written file. Write to a tmp name and publish atomically.
+
+The function-level granularity is the point: ``claim`` opens a tmp
+file and then ``os.link``\\ s it -- clean; a writer with no atomic
+publish anywhere in its body is a torn read waiting to happen.
+Genuinely exempt writes (e.g. a stop-marker whose CONTENT is
+irrelevant) carry an inline ``# pclint: disable=PCL012 -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+_WRITE_MODES = ("w", "wt", "wb", "w+", "wb+", "x", "xb")
+
+
+def _open_write_mode(node: ast.Call) -> str | None:
+    """The write mode of an ``open(...)`` call, else None."""
+    f = node.func
+    if not (isinstance(f, ast.Name) and f.id == "open"):
+        return None
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if mode.value in _WRITE_MODES else None
+    return None
+
+
+def _is_os_call(node: ast.Call, name: str) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == name
+            and isinstance(f.value, ast.Name) and f.value.id == "os")
+
+
+def _shallow_calls(body):
+    """Every Call in ``body`` NOT inside a nested function def."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _publishes_atomically(fn) -> bool:
+    """True when the function body calls ``os.replace`` or
+    ``os.link`` -- the tmp-then-publish pattern."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and (
+                _is_os_call(node, "replace") or _is_os_call(node, "link")):
+            return True
+    return False
+
+
+@register
+class AtomicWriteChecker(Checker):
+    rule = "PCL012"
+    name = "atomic-write"
+    description = ("bare open(..., 'w') / os.rename in a protocol "
+                   "file; use the tmp + os.replace / os.link "
+                   "crash-atomic idioms")
+    scope = ("pycatkin_tpu/robustness/scheduler.py",
+             "pycatkin_tpu/utils/io.py")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        # Each call is attributed to its INNERMOST enclosing function
+        # (the shallow iteration stops at nested defs, which are
+        # visited on their own); module-level writes have no enclosing
+        # publish to look for, so they are flagged unconditionally.
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                atomic = _publishes_atomically(node)
+                for call in _shallow_calls(node.body):
+                    yield from self._check_call(src, call, atomic,
+                                                node.name)
+        for call in _shallow_calls(src.tree.body):
+            yield from self._check_call(src, call, False, "<module>")
+
+    def _check_call(self, src, node, atomic: bool, where: str):
+        if _is_os_call(node, "rename"):
+            yield self.finding(
+                src, node,
+                f"os.rename in `{where}`: use os.replace (last-writer-"
+                f"wins) or os.link (first-writer-wins) so the intent "
+                f"is explicit and Windows semantics match")
+            return
+        mode = _open_write_mode(node)
+        if mode is not None and not atomic:
+            yield self.finding(
+                src, node,
+                f"bare open(..., {mode!r}) in `{where}` with no "
+                f"os.replace/os.link publish in the function: a "
+                f"concurrent reader can observe the torn file; write "
+                f"to a tmp name and publish atomically")

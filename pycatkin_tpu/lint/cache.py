@@ -1,0 +1,188 @@
+"""Incremental lint cache: unchanged files are not re-checked.
+
+``make lint`` runs every rule over every file on every invocation; the
+AST passes are cheap individually but the walk is O(repo) and the CI
+lane pays it twice (text + SARIF). This module gives :func:`run_lint`
+a content-addressed result cache in ``.pclint_cache/cache.json``:
+
+- the PER-FILE key is ``sha1(relpath | file sha | sorted rule ids |
+  salt)`` -- touch the file, change which rules apply, or change the
+  linter itself and the entry misses;
+- the ``salt`` hashes every ``pycatkin_tpu/lint/*.py`` source and every
+  ``docs/*.md`` the doc-backed checkers consult, so editing a RULE (or
+  the env/metric registries in the docs) invalidates everything without
+  any manual versioning;
+- PROJECT-LEVEL results (PCL013, computed over the whole
+  :class:`~pycatkin_tpu.lint.project_index.ProjectIndex`) are keyed on
+  a content hash of EVERY package file: any edit anywhere under
+  ``pycatkin_tpu/`` re-runs the cross-module pass, which is exactly its
+  invalidation contract.
+
+Suppression state is cache-safe by construction: inline suppressions
+are a function of file content (in the key) and the baseline is applied
+by the CLI *after* results leave the cache. The cache file itself is
+written tmp + ``os.replace`` (PCL012 practices what it preaches) and a
+corrupt/alien cache file is treated as empty, never an error.
+``pclint --no-cache`` bypasses reads and writes entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict
+from typing import Iterable, Optional
+
+from .core import Finding, iter_source_paths
+
+CACHE_DIRNAME = ".pclint_cache"
+CACHE_VERSION = 1
+
+# Hashed into the salt: the linter's own code plus the docs-as-registry
+# files rules validate against (PCL006 env table, PCL009 metric table).
+_SALT_DIRS = (("pycatkin_tpu/lint", ".py"), ("docs", ".md"))
+
+
+def _sha_bytes(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+def _sha_text(text: str) -> str:
+    return _sha_bytes(text.encode("utf-8", "replace"))
+
+
+def compute_salt(root: str) -> str:
+    """Hash of the linter sources + consulted docs: the cache's
+    self-invalidation lever."""
+    h = hashlib.sha1()
+    for sub, ext in _SALT_DIRS:
+        top = os.path.join(root, sub)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(ext):
+                    continue
+                ap = os.path.join(dirpath, fname)
+                try:
+                    with open(ap, "rb") as fh:
+                        h.update(fname.encode())
+                        h.update(fh.read())
+                except OSError:
+                    continue
+    return h.hexdigest()
+
+
+def project_content_key(root: str) -> str:
+    """Cheap (no-parse) content hash over every package module -- the
+    project-level (PCL013) cache key input. Matches the ProjectIndex
+    invalidation contract: ANY package edit changes it."""
+    from .project_index import INDEX_ROOTS
+    h = hashlib.sha1()
+    for path, relpath in iter_source_paths(root, paths=INDEX_ROOTS):
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            continue
+        h.update(relpath.replace("\\", "/").encode())
+        h.update(_sha_bytes(data).encode())
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return asdict(f)
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(**d)
+
+
+class LintCache:
+    """Content-addressed finding cache for one lint run.
+
+    Usage: construct, hand to :func:`run_lint(..., cache=...)`, call
+    :meth:`save` afterwards. Only keys touched THIS run survive the
+    save -- entries for contents that no longer exist age out for free.
+    """
+
+    def __init__(self, root: str, enabled: bool = True):
+        self.root = root
+        self.enabled = enabled
+        self.path = os.path.join(root, CACHE_DIRNAME, "cache.json")
+        self.hits = 0
+        self.misses = 0
+        self._salt: Optional[str] = None
+        self._entries: dict = {}
+        self._touched: dict = {}
+        if enabled:
+            self._load()
+
+    # -- keys ----------------------------------------------------------
+    @property
+    def salt(self) -> str:
+        if self._salt is None:
+            self._salt = compute_salt(self.root)
+        return self._salt
+
+    def file_key(self, relpath: str, text: str, rule_ids) -> str:
+        payload = "|".join((relpath.replace("\\", "/"),
+                            _sha_text(text),
+                            ",".join(sorted(rule_ids)), self.salt))
+        return _sha_text(payload)
+
+    def project_key(self, rule_ids) -> str:
+        payload = "|".join(("<project>", project_content_key(self.root),
+                            ",".join(sorted(rule_ids)), self.salt))
+        return _sha_text(payload)
+
+    # -- lookup --------------------------------------------------------
+    def get(self, key: str) -> Optional[list]:
+        if not self.enabled:
+            return None
+        hit = self._entries.get(key)
+        if hit is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._touched[key] = hit
+        try:
+            return [_finding_from_dict(d) for d in hit]
+        except TypeError:            # schema drift: treat as miss
+            self.misses += 1
+            self.hits -= 1
+            del self._touched[key]
+            return None
+
+    def put(self, key: str, findings: Iterable[Finding]) -> None:
+        if not self.enabled:
+            return
+        self._touched[key] = [_finding_to_dict(f) for f in findings]
+
+    # -- persistence ---------------------------------------------------
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if (not isinstance(data, dict)
+                or data.get("version") != CACHE_VERSION
+                or data.get("salt") != self.salt):
+            return                   # linter changed: start cold
+        entries = data.get("entries")
+        if isinstance(entries, dict):
+            self._entries = entries
+
+    def save(self) -> None:
+        if not self.enabled:
+            return
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"version": CACHE_VERSION, "salt": self.salt,
+                       "entries": self._touched}, fh)
+        os.replace(tmp, self.path)

@@ -13,6 +13,7 @@ import sys
 
 from . import baseline as bl
 from . import report
+from .cache import LintCache
 from .core import REPO_ROOT, all_checkers, checkers_for, run_lint
 
 
@@ -44,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline from the current active "
                         "findings and exit 0")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the incremental result cache "
+                        "(.pclint_cache/) -- re-check every file")
     p.add_argument("--list-rules", action="store_true",
                    help="list rule IDs and exit")
     p.add_argument("-v", "--verbose", action="store_true",
@@ -61,8 +65,10 @@ def main(argv=None) -> int:
 
     checkers = (checkers_for(args.rules.split(","))
                 if args.rules else all_checkers())
+    cache = LintCache(args.root, enabled=not args.no_cache)
     result = run_lint(root=args.root, checkers=checkers,
-                      paths=args.paths or None)
+                      paths=args.paths or None, cache=cache)
+    cache.save()
 
     baseline_path = args.baseline or bl.default_path(args.root)
     stale: list = []

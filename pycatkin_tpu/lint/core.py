@@ -46,7 +46,8 @@ DEFAULT_TOP_FILES = ("bench.py", "bench_suite.py", "__graft_entry__.py")
 # corpus (tests/lint_fixtures) that exists to be flagged ON PURPOSE by
 # the fixture tests -- explicit file arguments still reach it.
 EXCLUDE_DIRS = frozenset({"__pycache__", ".git", ".jax_aot_cache",
-                          ".ipynb_checkpoints", "lint_fixtures"})
+                          ".ipynb_checkpoints", ".pclint_cache",
+                          "lint_fixtures"})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*pclint:\s*disable=(?P<rules>[A-Za-z0-9_,\s]+?)"
@@ -166,6 +167,10 @@ class Checker:
     name = "base"
     description = ""
     scope: tuple = ("",)      # prefix "" = every scanned file
+    # Cross-module rules set True and implement check_project(); the
+    # runner builds ONE ProjectIndex per run, after the per-file walk,
+    # and hands it to each such checker.
+    needs_index = False
 
     def __init__(self):
         self.root = REPO_ROOT
@@ -177,6 +182,11 @@ class Checker:
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         raise NotImplementedError
+
+    def check_project(self, index) -> Iterable[Finding]:
+        """Cross-module pass over the shared ProjectIndex (only called
+        when ``needs_index`` is True)."""
+        return ()
 
     def finding(self, src: SourceFile, node, message: str) -> Finding:
         """Finding at an AST node, source line attached."""
@@ -202,9 +212,10 @@ def all_checkers() -> list[Checker]:
     """Instances of every registered checker, rule-ID order. Imports
     the built-in checker modules on first use so plain
     ``import pycatkin_tpu.lint.core`` stays dependency-free."""
-    from . import (abi_capture, dtype, env_registry,  # noqa: F401
-                   event_kinds, fault_sites, host_sync, metric_names,
-                   purity, tracer)
+    from . import (abi_capture, async_blocking,  # noqa: F401
+                   atomic_write, dtype, env_registry, event_kinds,
+                   fault_sites, fused_tail, host_sync, lock_discipline,
+                   metric_names, purity, tracer)
     return [_REGISTRY[rule]() for rule in sorted(_REGISTRY)]
 
 
@@ -282,11 +293,14 @@ def lint_file(checker: Checker, path: str, relpath: Optional[str] = None,
 
 
 def run_lint(root: Optional[str] = None, checkers=None,
-             paths=None) -> LintResult:
+             paths=None, cache=None) -> LintResult:
     """Walk the tree, run every (selected) checker on the files in its
-    scope, apply inline suppressions. Baseline suppression is applied
-    by the caller (:mod:`pycatkin_tpu.lint.cli`) so programmatic users
-    can inspect the raw findings."""
+    scope, apply inline suppressions, then run the cross-module
+    (``needs_index``) checkers once over a shared ProjectIndex.
+    Baseline suppression is applied by the caller
+    (:mod:`pycatkin_tpu.lint.cli`) so programmatic users can inspect
+    the raw findings. ``cache`` (a :class:`pycatkin_tpu.lint.cache.
+    LintCache`) short-circuits unchanged files; the caller saves it."""
     root = root or REPO_ROOT
     if checkers is None:
         checkers = all_checkers()
@@ -299,17 +313,62 @@ def run_lint(root: Optional[str] = None, checkers=None,
             continue
         src = SourceFile(path, relpath)
         result.n_files += 1
+        key = None
+        if cache is not None and cache.enabled:
+            key = cache.file_key(src.relpath, src.text,
+                                 [c.rule for c in wanted])
+            hit = cache.get(key)
+            if hit is not None:
+                result.findings.extend(hit)
+                continue
         try:
             src.tree
         except SyntaxError as e:
-            result.findings.append(Finding(
+            f = Finding(
                 rule="PCL000", path=src.relpath,
                 lineno=e.lineno or 1, col=e.offset or 0,
                 message=f"syntax error: {e.msg}",
-                source=(e.text or "").strip()))
+                source=(e.text or "").strip())
+            result.findings.append(f)
+            if key is not None:
+                cache.put(key, [f])
             continue
+        file_findings: list[Finding] = []
         for c in wanted:
-            result.findings.extend(
-                _apply_inline(src, c.check_file(src)))
+            file_findings.extend(_apply_inline(src, c.check_file(src)))
+        if key is not None:
+            cache.put(key, file_findings)
+        result.findings.extend(file_findings)
+    project = [c for c in checkers if c.needs_index]
+    if project:
+        result.findings.extend(_run_project(root, project, cache))
     result.findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
     return result
+
+
+def _run_project(root: str, project, cache) -> list[Finding]:
+    """The cross-module pass: one ProjectIndex, every needs_index
+    checker, inline suppression resolved through the index's own
+    SourceFiles. Cached on the WHOLE-package content key -- any edit
+    under the package re-runs it."""
+    key = None
+    if cache is not None and cache.enabled:
+        key = cache.project_key([c.rule for c in project])
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    from .project_index import ProjectIndex
+    index = ProjectIndex.build(root)
+    out: list[Finding] = []
+    for c in project:
+        for f in c.check_project(index):
+            mod = index.modules.get(f.path)
+            if mod is not None:
+                reason = mod.src.disabled(f.rule, f.lineno, f.end_lineno)
+                if reason is not None:
+                    f.suppressed = "inline"
+                    f.reason = reason
+            out.append(f)
+    if key is not None:
+        cache.put(key, out)
+    return out

@@ -1,0 +1,103 @@
+"""PCL013 fused-tail integrity: the hot-path registry matches the call
+graph.
+
+PCL001 only watches functions registered in the hot-path registry
+(:mod:`pycatkin_tpu.lint.hotpath`, now the ``@hotpath`` decorator
+scan). That leaves one drift class open: a function REACHABLE from the
+fused/packed sweep bodies that materializes device values but was
+never decorated -- its syncs are invisible to both the static check
+and the budget test's attribution. This rule closes it over the
+:class:`~pycatkin_tpu.lint.project_index.ProjectIndex` call graph:
+
+    for every function reachable from the sweep roots
+    (the decorated entry points themselves), if its body contains a
+    PCL001-style sync primitive -- ``np.asarray(...)``,
+    ``int()/float()`` over a jnp expression, or a counted
+    ``host_sync(...)`` call -- it must be ``@hotpath``-decorated.
+
+Fix by decorating the function (which puts it under PCL001's per-line
+scrutiny, where reviewed transfers carry ``# sync-ok:``), or suppress
+at the function's ``def`` line with a reason when the np.asarray is a
+pure host-side conversion (numpy in, numpy out -- free, no device
+round trip).
+
+This is the cross-module rule: it runs once per lint pass over the
+shared index (``needs_index = True`` / ``check_project``), not per
+file, and the incremental cache keys it on the WHOLE index content
+(any package edit re-runs it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, register
+from .host_sync import _is_np_asarray, _is_scalar_pull
+from .hotpath import hot_path_files
+
+
+def _sync_primitive(fn_node) -> ast.Call | None:
+    """First PCL001-style sync primitive in a function body (nested
+    defs included -- closures run on the caller's path), or None."""
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_np_asarray(node) or _is_scalar_pull(node):
+            return node
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "host_sync":
+            return node
+        if (isinstance(f, ast.Attribute) and f.attr == "host_sync"):
+            return node
+    return None
+
+
+@register
+class FusedTailChecker(Checker):
+    rule = "PCL013"
+    name = "fused-tail"
+    description = ("function reachable from the fused/packed sweep "
+                   "bodies materializes device values but is not "
+                   "@hotpath-decorated (hot-path registry drift)")
+    needs_index = True
+
+    def wants(self, relpath: str) -> bool:
+        return False                  # project-level rule only
+
+    def check_file(self, src) -> Iterable[Finding]:
+        return ()
+
+    def roots(self) -> set:
+        """(relpath, fname) sweep entry points: every decorated
+        function -- the fused/packed sweep bodies plus whatever they
+        already pulled into the registry."""
+        out = set()
+        for rel, names in hot_path_files(self.root).items():
+            out |= {(rel, n) for n in names}
+        return out
+
+    def check_project(self, index) -> Iterable[Finding]:
+        registered = hot_path_files(self.root)
+        for relpath, fname in sorted(index.reachable(self.roots())):
+            if fname in registered.get(relpath, frozenset()):
+                continue
+            mod = index.modules.get(relpath)
+            info = mod.functions.get(fname) if mod else None
+            if info is None:
+                continue
+            call = _sync_primitive(info.node)
+            if call is None:
+                continue
+            src = mod.src
+            f = Finding(
+                rule=self.rule, path=relpath, lineno=info.lineno,
+                col=getattr(info.node, "col_offset", 0),
+                message=(f"`{fname}` is reachable from the fused/"
+                         f"packed sweep bodies and materializes "
+                         f"device values (line {call.lineno}) but is "
+                         f"not @hotpath-decorated; decorate it so "
+                         f"PCL001 and the sync-budget test see it"),
+                source=src.line(info.lineno).strip(),
+                end_lineno=info.lineno)
+            yield f

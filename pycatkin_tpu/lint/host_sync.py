@@ -86,13 +86,13 @@ class HostSyncChecker(Checker):
     def wants(self, relpath: str) -> bool:
         if self.hot_paths is not None:
             return relpath.replace("\\", "/") in self.hot_paths
-        return hot_functions_for(relpath) is not None
+        return hot_functions_for(relpath, self.root) is not None
 
     def _functions_for(self, relpath: str):
         if self.hot_paths is not None:
             hit = self.hot_paths.get(relpath.replace("\\", "/"))
         else:
-            hit = hot_functions_for(relpath)
+            hit = hot_functions_for(relpath, self.root)
         # Direct lint_file() calls on fixture copies fall back to the
         # full registered-name union.
         return hit if hit is not None else HOT_FUNCTIONS

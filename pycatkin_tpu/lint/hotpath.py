@@ -1,21 +1,35 @@
 """The sweep hot-path registry: ONE place naming the functions whose
 host-sync behavior is contractual.
 
-Two enforcement mechanisms consume this module and must agree exactly:
+Membership is declared AT THE FUNCTION, not in a hand-maintained list:
+decorating a function with :func:`hotpath` (a runtime no-op) puts it on
+the registry, and the static side recovers the same set by scanning the
+``@hotpath`` decorations in :data:`HOT_PATH_SCAN_FILES` with ``ast`` --
+no import of JAX-heavy modules, so the linter stays fast and robust.
+Three enforcement mechanisms consume this module and must agree exactly:
 
 - ``tests/test_sync_budget.py`` holds a clean sweep to
   :data:`MAX_CLEAN_SYNCS` counted materializations at runtime;
 - the ``PCL001`` host-sync checker (:mod:`pycatkin_tpu.lint.host_sync`,
   ``make lint``) statically flags raw materialization idioms inside the
-  registered functions.
+  decorated functions;
+- the ``PCL013`` fused-tail-integrity checker
+  (:mod:`pycatkin_tpu.lint.fused_tail`) walks the ProjectIndex call
+  graph from the fused/packed sweep bodies and fails when a reachable
+  sync-calling function is NOT decorated -- the drift class the old
+  hand-maintained list suffered from is now a lint error.
 
-Before this module existed the function list lived twice (the lint
-script and the budget test) and could silently drift: a function added
-to the hot path but only one list would be half-enforced. Add new
-hot-path files/functions HERE, nowhere else.
+To put a new function under the contract, decorate it with
+``@hotpath`` -- nothing else to update anywhere.
 """
 
 from __future__ import annotations
+
+import ast
+import os
+from functools import lru_cache
+
+from .core import REPO_ROOT
 
 # A clean (zero-failure) sweep_steady_state may spend at most this many
 # counted blocking device->host materializations (tightened from the
@@ -30,30 +44,87 @@ MAX_CLEAN_SYNCS = 2
 # call's first line).
 SYNC_ANNOTATION = "# sync-ok:"
 
-# The sweep hot path: functions a clean (zero-failure) sweep executes,
-# plus the failure-path functions whose syncs must stay labeled.
-HOT_FUNCTIONS = frozenset({
-    "batch_steady_state", "sweep_steady_state", "_finish_sweep",
-    "_fused_sweep", "_assemble_clean", "_stability_tier2",
-    "_rescue", "_quarantine_mask", "stability_mask",
-    "continuation_sweep",
-    # Packed multi-tenant batching: the packed dispatch + the shared
-    # post-bundle triage. A stray materialization in _fused_decide
-    # would multiply by K tenants, so it is held to the same
-    # discipline (the packed clean path spends exactly ONE counted
-    # sync total, regardless of K -- test_sync_budget.py pins it).
-    "packed_sweep_steady_state", "_packed_fused_sweep",
-    "_split_fused_out", "_fused_decide",
-})
+# Files scanned for ``@hotpath`` decorations (repo-relative posix
+# paths). A decorated function in an UNLISTED file is invisible to the
+# static side, so PCL013's drift test also asserts the runtime registry
+# (populated at import) stays inside this file set.
+HOT_PATH_SCAN_FILES = ("pycatkin_tpu/parallel/batch.py",)
 
-# file (posix path relative to the repo root) -> hot function names.
-# The PCL001 checker scans exactly these files.
-HOT_PATH_FILES: dict[str, frozenset[str]] = {
-    "pycatkin_tpu/parallel/batch.py": HOT_FUNCTIONS,
-}
+# Runtime half of the registry: (module, qualname) of every function
+# decorated in THIS process. Filled as modules import; the static scan
+# below is authoritative for lint/tests (it needs no imports).
+_RUNTIME_REGISTRY: set = set()
 
 
-def hot_functions_for(relpath: str):
+def hotpath(fn):
+    """Declare ``fn`` part of the sweep hot path (host-sync contract:
+    PCL001 static scan + tests/test_sync_budget.py runtime budget).
+    Returns ``fn`` unchanged -- zero call overhead; decoration is pure
+    registration."""
+    _RUNTIME_REGISTRY.add((getattr(fn, "__module__", ""),
+                           getattr(fn, "__qualname__", fn.__name__)))
+    return fn
+
+
+def runtime_registry() -> frozenset:
+    """(module, qualname) pairs decorated so far in this process."""
+    return frozenset(_RUNTIME_REGISTRY)
+
+
+def _decorator_names(node) -> set:
+    out = set()
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name):
+            out.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            out.add(target.attr)
+    return out
+
+
+def _scan_file(path: str) -> frozenset:
+    """Top-level function names decorated ``@hotpath`` in one file
+    (empty when the file is missing/unparsable -- the lint pass reports
+    syntax errors separately as PCL000)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return frozenset()
+    return frozenset(
+        top.name for top in tree.body
+        if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and "hotpath" in _decorator_names(top))
+
+
+@lru_cache(maxsize=8)
+def _scan(root: str) -> dict:
+    return {rel: _scan_file(os.path.join(root, rel))
+            for rel in HOT_PATH_SCAN_FILES
+            if os.path.isfile(os.path.join(root, rel))}
+
+
+def hot_path_files(root: str = REPO_ROOT) -> dict:
+    """file (posix path relative to ``root``) -> decorated function
+    names, from the static ``@hotpath`` scan."""
+    return dict(_scan(root))
+
+
+def hot_functions_for(relpath: str, root: str = REPO_ROOT):
     """Hot-function set for a repo-relative posix path (None when the
     file carries no hot-path contract)."""
-    return HOT_PATH_FILES.get(relpath.replace("\\", "/"))
+    return _scan(root).get(relpath.replace("\\", "/"))
+
+
+def _union(root: str = REPO_ROOT) -> frozenset:
+    out = set()
+    for names in _scan(root).values():
+        out |= names
+    return frozenset(out)
+
+
+# Back-compatible module-level views (consumed by lint/__init__ and the
+# budget test). Computed from the decorator scan at import time -- the
+# hand-maintained list these used to be is gone.
+HOT_FUNCTIONS = _union()
+HOT_PATH_FILES: dict = hot_path_files()

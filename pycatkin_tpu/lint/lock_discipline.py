@@ -1,0 +1,150 @@
+"""PCL011 lock-discipline: guarded attributes are touched under their
+lock.
+
+An attribute initialized with a trailing ``# guarded-by: <lock>``
+comment declares a locking contract for its owning class::
+
+    class SweepCoalescer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._groups = {}       # guarded-by: _lock
+
+Every ``self._groups`` access (read or write) in any OTHER method of
+the class must then sit lexically inside a ``with self._lock:`` (or
+``async with``) block. The declaring method itself -- ``__init__``
+construction happens before the object is published to other threads
+-- is exempt. Deliberately lock-free accesses (benign racy reads like
+a ``pending`` progress counter) carry an inline
+``# pclint: disable=PCL011 -- <why the race is benign>``.
+
+This is a LEXICAL check: helper methods documented as
+"caller must hold the lock" need a suppression at their access sites
+(which is exactly the reviewed paper trail such helpers should carry).
+Accesses from OUTSIDE the class body are not checked -- the contract
+is an implementation-discipline rule, not an escape analysis.
+
+Seeded on: :class:`parallel.dispatch.SweepCoalescer` (queue dicts),
+:class:`obs.metrics.MetricsRegistry` / ``_Instrument`` (instrument
+tables), :class:`obs.trace.RunTrace` (event/sync state) and the
+elastic scheduler's heartbeat bookkeeping
+(:class:`robustness.scheduler._Heartbeat`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?"
+                         r"(?P<lock>[A-Za-z_]\w*)")
+
+
+def _self_attr(node) -> str | None:
+    """``attr`` for an ``self.<attr>`` expression, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _declarations(src: SourceFile, cls: ast.ClassDef) -> dict:
+    """{attr: (lock, declaring-method-name)} from ``# guarded-by``
+    comments on ``self.<attr> = ...`` assignments anywhere in the
+    class body."""
+    out: dict = {}
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            else:
+                continue
+            attrs = [a for a in map(_self_attr, targets)
+                     if a is not None]
+            if not attrs:
+                continue
+            for i in src.span_lines(node.lineno,
+                                    getattr(node, "end_lineno", None)):
+                m = _GUARDED_RE.search(src.line(i))
+                if m:
+                    for attr in attrs:
+                        out[attr] = (m.group("lock"), method.name)
+                    break
+    return out
+
+
+def _with_locks(stmt) -> set:
+    """Lock attr names taken by one with/async-with statement
+    (``with self._lock:`` / ``with self._lock as h:``)."""
+    out = set()
+    for item in stmt.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "PCL011"
+    name = "lock-discipline"
+    description = ("access to a '# guarded-by: <lock>' attribute "
+                   "outside a 'with self.<lock>:' block")
+    scope = ("pycatkin_tpu/",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        for top in ast.walk(src.tree):
+            if isinstance(top, ast.ClassDef):
+                yield from self._check_class(src, top)
+
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef):
+        decls = _declarations(src, cls)
+        if not decls:
+            return
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            declared_here = {a for a, (_, m) in decls.items()
+                             if m == method.name}
+            yield from self._check_body(
+                src, cls, method, method.body, decls, declared_here,
+                held=frozenset())
+
+    def _check_body(self, src, cls, method, body, decls, exempt, held):
+        for stmt in body:
+            yield from self._check_node(src, cls, method, stmt, decls,
+                                        exempt, held)
+
+    def _check_node(self, src, cls, method, node, decls, exempt, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | _with_locks(node)
+            for item in node.items:
+                yield from self._check_node(src, cls, method,
+                                            item.context_expr, decls,
+                                            exempt, held)
+            yield from self._check_body(src, cls, method, node.body,
+                                        decls, exempt, inner)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in decls and attr not in exempt:
+            lock, declared_in = decls[attr]
+            if lock not in held:
+                yield self.finding(
+                    src, node,
+                    f"`self.{attr}` is guarded by `self.{lock}` "
+                    f"(declared in {cls.name}.{declared_in}) but "
+                    f"accessed outside `with self.{lock}:` in "
+                    f"`{method.name}`")
+            return          # don't descend into self.<attr> again
+        for child in ast.iter_child_nodes(node):
+            yield from self._check_node(src, cls, method, child, decls,
+                                        exempt, held)

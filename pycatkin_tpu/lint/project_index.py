@@ -1,0 +1,257 @@
+"""Cross-module project index for pclint.
+
+The per-file checkers (PCL001..PCL012) see one AST at a time, which is
+exactly the blind spot the hand-maintained hot-path registry papered
+over: whether a function is ON the sweep hot path is a property of the
+CALL GRAPH, not of any single file. :class:`ProjectIndex` parses every
+package module once and exposes
+
+- per-module ASTs and content hashes (the hashes also drive the
+  incremental lint cache's invalidation, :mod:`pycatkin_tpu.lint.cache`);
+- a name-resolution table per module (top-level functions, ``from x
+  import y`` aliases, imported-module aliases);
+- a conservative function-level call graph with reachability queries.
+
+Resolution is deliberately LIGHT: a call edge is recorded when the
+callee resolves to a top-level function of the same module, to a
+``from``-imported function of another package module, or to
+``alias.func`` through an imported-module alias. Method calls and
+dynamic dispatch are not chased -- a cross-module rule built on this
+index (PCL013) trades exhaustiveness for zero false edges, the right
+trade for a gating linter.
+
+Checkers opt in by setting ``needs_index = True`` and implementing
+``check_project(index)`` (see :class:`pycatkin_tpu.lint.core.Checker`);
+the runner builds ONE index per run and hands it to each of them after
+the per-file walk.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import SourceFile, iter_source_paths
+
+# Only package modules join the call graph: tests/tools/examples call
+# INTO the package but are never on the sweep hot path themselves.
+INDEX_ROOTS = ("pycatkin_tpu",)
+
+PACKAGE = "pycatkin_tpu"
+
+
+@dataclass
+class FunctionInfo:
+    """One top-level function (or method, qualname ``Class.name``)."""
+
+    name: str
+    relpath: str
+    lineno: int
+    end_lineno: Optional[int]
+    node: ast.AST
+    # Called names as written: bare identifiers from ``f(...)`` plus
+    # ``alias.attr`` pairs from ``mod.f(...)``.
+    calls: set = field(default_factory=set)          # {str}
+    attr_calls: set = field(default_factory=set)     # {(base, attr)}
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str
+    path: str
+    sha: str
+    src: SourceFile
+    functions: dict = field(default_factory=dict)    # name -> FunctionInfo
+    # local name -> (module relpath, original name) for
+    # ``from .x import y [as z]`` where .x resolves inside the package.
+    from_imports: dict = field(default_factory=dict)
+    # local alias -> module relpath for ``from .. import engine`` /
+    # ``import pycatkin_tpu.engine as engine``.
+    module_aliases: dict = field(default_factory=dict)
+
+
+def _sha_text(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+
+
+def _module_relpath(dotted: str) -> Optional[str]:
+    """``pycatkin_tpu.parallel.batch`` -> its repo-relative file path
+    (None for names outside the package; packages map to __init__.py)."""
+    if dotted != PACKAGE and not dotted.startswith(PACKAGE + "."):
+        return None
+    return dotted.replace(".", "/") + ".py"
+
+
+def _resolve_relative(relpath: str, level: int, module: str) -> str:
+    """Absolute dotted name of a relative import written in ``relpath``
+    (``level`` leading dots, ``module`` the trailing name, may be '')."""
+    pkg_parts = relpath[:-len(".py")].replace("\\", "/").split("/")
+    if pkg_parts[-1] == "__init__":
+        pkg_parts = pkg_parts[:-1]
+    else:
+        pkg_parts = pkg_parts[:-1]          # containing package
+    base = pkg_parts[:len(pkg_parts) - (level - 1)] if level > 1 \
+        else pkg_parts
+    return ".".join(base + ([module] if module else []))
+
+
+def _collect_calls(fn_node, info: FunctionInfo) -> None:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            info.calls.add(f.id)
+        elif isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                         ast.Name):
+            info.attr_calls.add((f.value.id, f.attr))
+
+
+class ProjectIndex:
+    """Parsed view of every package module plus the call graph."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: dict = {}               # relpath -> ModuleInfo
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def build(cls, root: str) -> "ProjectIndex":
+        idx = cls(root)
+        for path, relpath in iter_source_paths(root, paths=INDEX_ROOTS):
+            idx._add_file(path, relpath.replace("\\", "/"))
+        return idx
+
+    def _add_file(self, path: str, relpath: str) -> None:
+        try:
+            src = SourceFile(path, relpath)
+            tree = src.tree
+        except (OSError, SyntaxError):
+            return                           # PCL000 reports it already
+        mod = ModuleInfo(relpath=relpath, path=path,
+                         sha=_sha_text(src.text), src=src)
+        for top in tree.body:
+            if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(top.name, relpath, top.lineno,
+                                    getattr(top, "end_lineno", None),
+                                    top)
+                _collect_calls(top, info)
+                mod.functions[top.name] = info
+            elif isinstance(top, ast.ClassDef):
+                for item in top.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f"{top.name}.{item.name}"
+                        info = FunctionInfo(qual, relpath, item.lineno,
+                                            getattr(item, "end_lineno",
+                                                    None), item)
+                        _collect_calls(item, info)
+                        mod.functions[qual] = info
+            elif isinstance(top, ast.ImportFrom):
+                dotted = _resolve_relative(relpath, top.level,
+                                           top.module or "") \
+                    if top.level else (top.module or "")
+                target = _module_relpath(dotted)
+                for alias in top.names:
+                    local = alias.asname or alias.name
+                    if target is not None:
+                        # ``from .x import y``: y may be a function of
+                        # x OR a submodule of package x.
+                        sub = _module_relpath(f"{dotted}.{alias.name}")
+                        mod.from_imports[local] = (target, alias.name)
+                        if sub is not None:
+                            mod.module_aliases.setdefault(local, sub)
+            elif isinstance(top, ast.Import):
+                for alias in top.names:
+                    target = _module_relpath(alias.name)
+                    if target is not None:
+                        local = alias.asname or alias.name.split(".")[0]
+                        mod.module_aliases[local] = target
+        self.modules[relpath] = mod
+
+    # -- cache invalidation hook ---------------------------------------
+    def content_key(self) -> str:
+        """One hash covering every indexed file: any edit anywhere in
+        the package changes it (the PCL013 cache key)."""
+        h = hashlib.sha1()
+        for relpath in sorted(self.modules):
+            h.update(relpath.encode())
+            h.update(self.modules[relpath].sha.encode())
+        return h.hexdigest()
+
+    # -- resolution / call graph ---------------------------------------
+    def _module_file(self, relpath: str) -> Optional[ModuleInfo]:
+        m = self.modules.get(relpath)
+        if m is None and relpath.endswith(".py"):
+            # package import: pycatkin_tpu/engine.py vs engine/__init__
+            m = self.modules.get(relpath[:-3] + "/__init__.py")
+        return m
+
+    def resolve(self, relpath: str, name: str):
+        """``(ModuleInfo, FunctionInfo)`` the bare name ``name`` used in
+        module ``relpath`` refers to, or None."""
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return None
+        fn = mod.functions.get(name)
+        if fn is not None:
+            return mod, fn
+        imp = mod.from_imports.get(name)
+        if imp is not None:
+            target_rel, orig = imp
+            target = self._module_file(target_rel)
+            if target is not None:
+                fn = target.functions.get(orig)
+                if fn is not None:
+                    return target, fn
+        return None
+
+    def resolve_attr(self, relpath: str, base: str, attr: str):
+        """``(ModuleInfo, FunctionInfo)`` for ``base.attr(...)`` where
+        ``base`` is an imported-module alias, or None."""
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return None
+        target_rel = mod.module_aliases.get(base)
+        if target_rel is None:
+            return None
+        target = self._module_file(target_rel)
+        if target is None:
+            return None
+        fn = target.functions.get(attr)
+        return (target, fn) if fn is not None else None
+
+    def callees(self, relpath: str, fname: str):
+        """Resolved ``(relpath, fname)`` edges out of one function."""
+        mod = self.modules.get(relpath)
+        if mod is None or fname not in mod.functions:
+            return []
+        info = mod.functions[fname]
+        out = []
+        for name in sorted(info.calls):
+            hit = self.resolve(relpath, name)
+            if hit is not None:
+                out.append((hit[0].relpath, hit[1].name))
+        for base, attr in sorted(info.attr_calls):
+            hit = self.resolve_attr(relpath, base, attr)
+            if hit is not None:
+                out.append((hit[0].relpath, hit[1].name))
+        return out
+
+    def reachable(self, roots) -> set:
+        """Every ``(relpath, fname)`` reachable from ``roots`` (roots
+        included) over the resolved call graph."""
+        seen = set()
+        stack = [r for r in roots]
+        while stack:
+            node = stack.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self.callees(*node):
+                if nxt not in seen:
+                    stack.append(nxt)
+        return seen

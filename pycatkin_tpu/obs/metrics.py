@@ -49,7 +49,7 @@ class _Instrument:
         self.name = name
         self.help = help_text
         self._lock = lock
-        self._values: dict = {}
+        self._values: dict = {}   # guarded-by: _lock
 
     def _check_labels(self, labels: dict):
         for k in labels:
@@ -160,7 +160,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict = {}
+        self._metrics: dict = {}  # guarded-by: _lock
 
     def _get(self, cls, name, help_text, **kwargs):
         with self._lock:

@@ -55,9 +55,9 @@ class RunTrace:
         self.trace_id = next(_TRACE_IDS)
         self.parent = parent
         self.lock = threading.Lock()
-        self.events: list = []
-        self.sync_count = 0
-        self.sync_labels: list = []
+        self.events: list = []       # guarded-by: lock
+        self.sync_count = 0          # guarded-by: lock
+        self.sync_labels: list = []  # guarded-by: lock
         # Monotonic base: Chrome-trace timestamps are exported relative
         # to this so a trace starts near ts=0.
         self.t0 = time.monotonic()

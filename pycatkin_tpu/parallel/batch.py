@@ -30,7 +30,9 @@ from .. import engine
 from .. import precision as _precision
 from ..frontend import abi as _abi
 from ..frontend.spec import Conditions, ModelSpec
+from ..lint.hotpath import hotpath
 from ..obs import costs as _costs
+from ..san import recompile as _san_recompile
 from ..obs import metrics as _metrics
 from ..solvers.newton import STRATEGY_CODES, SolverOptions
 from ..solvers.ode import ODEOptions
@@ -212,6 +214,10 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
     args = _prog_args(spec, args)
     spec = _prog_spec(spec)
     key = compile_pool.program_key(kind, args)
+    # pcsan seam: records (cold) / verifies (warm) the program key --
+    # a never-seen key after mark_warm() is an in-band recompile about
+    # to happen. One bool check when the sanitizer is off.
+    _san_recompile.note_program(kind, key, args)
     exe = compile_pool.lookup(spec, key)
     if exe is not None:
         t0 = _time_mod.perf_counter()
@@ -469,6 +475,7 @@ def _pad_lanes(conds: Conditions, multiple: int):
     return jax.tree_util.tree_map(pad, conds), n
 
 
+@hotpath
 def batch_steady_state(spec: ModelSpec, conds: Conditions,
                        x0: Optional[jnp.ndarray] = None,
                        opts: SolverOptions = SolverOptions(),
@@ -1058,6 +1065,7 @@ def _place_subset(mesh: Optional[Mesh], n_sub: int, *trees):
     return placed if len(placed) > 1 else placed[0]
 
 
+@hotpath
 def stability_mask(spec: ModelSpec, conds: Conditions, ys,
                    pos_tol: float = 1e-2, ok=None,
                    backend: Optional[str] = None,
@@ -1131,6 +1139,7 @@ def stability_mask(spec: ModelSpec, conds: Conditions, ys,
     return certified
 
 
+@hotpath
 def _stability_tier2(spec: ModelSpec, conds: Conditions, ys,
                      idx: np.ndarray, certified_host: np.ndarray,
                      pos_tol: float,
@@ -1172,6 +1181,7 @@ def _stability_tier2(spec: ModelSpec, conds: Conditions, ys,
     return certified_host
 
 
+@hotpath
 def _neighbor_seed_lanes(conds: Conditions, success: np.ndarray):
     """For each failed lane, the index of the nearest CONVERGED lane in
     (z-scored) condition space, or None when unavailable.
@@ -1200,7 +1210,7 @@ def _neighbor_seed_lanes(conds: Conditions, success: np.ndarray):
                                          label="neighbor-seed transfer")
     feats = []
     for a in jax.tree_util.tree_leaves(host_conds):
-        a = np.asarray(a)
+        a = np.asarray(a)  # sync-ok: host leaf of the batched transfer above
         if a.ndim >= 1 and a.shape[0] == n:
             f = a.reshape(n, -1).astype(np.float64)
             std = f.std(axis=0)
@@ -1236,6 +1246,7 @@ def _chunked_nearest(Xf: np.ndarray, Xo: np.ndarray,
     return nn
 
 
+@hotpath
 def _rescue(spec: ModelSpec, conds: Conditions, res,
             opts: SolverOptions, strategy: str, pad_to: int = 64,
             seed: int = 1, use_x0: bool = True,
@@ -1400,6 +1411,7 @@ def _rescue(spec: ModelSpec, conds: Conditions, res,
     return merged, n_remaining
 
 
+@hotpath
 def sweep_steady_state(spec: ModelSpec, conds: Conditions, tof_mask=None,
                        x0=None, opts: SolverOptions = SolverOptions(),
                        mesh: Optional[Mesh] = None,
@@ -1495,6 +1507,7 @@ def _sweep_steady_state_tail(spec, conds, tof_mask, x0, opts, mesh,
                          mesh=tail_mesh, tier=_precision.active_tier())
 
 
+@hotpath
 def _assemble_clean(res, quar, stable, tofs, act,
                     check_stability: bool, has_tof: bool, n_neg: int,
                     lane_tel=None):
@@ -1524,6 +1537,7 @@ def _assemble_clean(res, quar, stable, tofs, act,
     return out
 
 
+@hotpath
 def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
                  opts: SolverOptions, check_stability: bool,
                  pos_jac_tol: float, mesh: Optional[Mesh] = None):
@@ -1594,6 +1608,7 @@ def _fused_sweep(spec: ModelSpec, conds: Conditions, tof_mask, x0,
                          pos_jac_tol, mesh, tier, backend, parts)
 
 
+@hotpath
 def _split_fused_out(out, check_stability: bool, has_tof: bool):
     """Name the fused program's positional output tuple (after the tail
     bundle sync replaced the last two slots with host arrays):
@@ -1613,6 +1628,7 @@ def _split_fused_out(out, check_stability: bool, has_tof: bool):
             out[pos + 1])
 
 
+@hotpath
 def _fused_decide(spec: ModelSpec, conds: Conditions, tof_mask,
                   opts: SolverOptions, check_stability: bool,
                   pos_jac_tol: float, mesh: Optional[Mesh], tier: str,
@@ -1697,6 +1713,7 @@ def _packed_kind(opts: SolverOptions, pos_tol: float, backend: str,
             + compile_pool.tenant_tag(k_bucket))
 
 
+@hotpath
 def _packed_fused_sweep(pack, conds_list, mask_list, x0_list,
                         opts: SolverOptions, check_stability: bool,
                         pos_jac_tol: float):
@@ -1774,6 +1791,7 @@ def _packed_fused_sweep(pack, conds_list, mask_list, x0_list,
     return results
 
 
+@hotpath
 def packed_sweep_steady_state(specs, conds, tof_mask=None, x0=None,
                               opts: SolverOptions = SolverOptions(),
                               check_stability: bool = False,
@@ -1967,6 +1985,8 @@ def prewarm_packed_sweep_programs(specs, conds, tof_mask=None,
             compile_pool.register(pspec, key, exe)
             stats.loaded = 1
         else:
+            _san_recompile.note_compile(
+                f"packed fused sweep @{n_lanes} x{kb}")
             exe = call_with_backend_retry(
                 lambda: prog.lower(*args).compile(),
                 label=f"compile:packed fused sweep @{n_lanes} x{kb}")
@@ -1988,6 +2008,7 @@ def prewarm_packed_sweep_programs(specs, conds, tof_mask=None,
     return stats
 
 
+@hotpath
 def _quarantine_mask(res, quarantined=None):
     """Per-lane NaN quarantine: lanes FLAGGED converged whose stored
     solution or residual is non-finite are silently-poisoned results (a
@@ -2034,6 +2055,7 @@ _LANE_DECADE_BUCKETS = (-16.0, -14.0, -12.0, -10.0, -8.0, -6.0, -4.0,
                         -2.0, 0.0)
 
 
+@hotpath
 def _note_lane_telemetry(tel, spec):
     """Feed one sweep's materialized [lanes, 5] telemetry pack into the
     per-lane histograms, labeled by the ABI bucket the sweep ran in
@@ -2042,7 +2064,7 @@ def _note_lane_telemetry(tel, spec):
     if tel is None:
         return
     bucket = str(getattr(spec, "abi_fingerprint", None) or "unbucketed")
-    tel = np.asarray(tel)
+    tel = np.asarray(tel)  # sync-ok: pack already materialized by caller
     _metrics.histogram(
         "pycatkin_lane_iterations",
         "per-lane solver iteration counts",
@@ -2060,6 +2082,7 @@ def _note_lane_telemetry(tel, spec):
             tel[:, 2], abi_bucket=bucket)
 
 
+@hotpath
 def _host_lane_telemetry(res, quar, strategy_codes,
                          first_pass_tier: int = 0):
     """Host-side twin of :func:`solvers.newton.packed_lane_telemetry`
@@ -2081,7 +2104,7 @@ def _host_lane_telemetry(res, quar, strategy_codes,
     dec = np.clip(dec, -99, 99).astype(np.int32)
     strat = np.where(np.asarray(quar).astype(bool),  # sync-ok: failure path
                      np.int32(STRATEGY_CODES["quarantine"]),
-                     np.asarray(strategy_codes, dtype=np.int32))
+                     np.asarray(strategy_codes, dtype=np.int32))  # sync-ok: failure path
     strat = strat.astype(np.int32)
     ok = np.asarray(res.success).astype(bool)  # sync-ok: failure path
     tcol = np.where(ok & (strat == 0), np.int32(first_pass_tier),
@@ -2089,6 +2112,7 @@ def _host_lane_telemetry(res, quar, strategy_codes,
     return np.stack([it, ch, dec, strat, tcol], axis=-1)
 
 
+@hotpath
 def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
                   opts: SolverOptions, tof_mask, check_stability: bool,
                   pos_jac_tol: float, backend: Optional[str] = None,
@@ -2317,6 +2341,7 @@ def _finish_sweep(spec: ModelSpec, conds: Conditions, res,
     return out
 
 
+@hotpath
 def continuation_sweep(spec: ModelSpec, conds: Conditions, order,
                        tof_mask=None,
                        opts: SolverOptions = SolverOptions(),
@@ -2629,6 +2654,7 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         Cache entries record the argument sharding fingerprint, so a
         sharded executable is never deserialized into a process whose
         device population cannot satisfy it (silent miss, recompile)."""
+        _san_recompile.note_compile(job["label"])
         exe = call_with_backend_retry(
             lambda: job["prog"].lower(*job["args"]).compile(),
             label=f"compile:{job['label']}")

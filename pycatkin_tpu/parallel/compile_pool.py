@@ -182,7 +182,7 @@ def args_sharding_fingerprint(args) -> str:
     return "|".join(tags) if any(tags) else ""
 
 
-def _shape_signature(args) -> str:
+def _shape_signature(args) -> str:  # pclint: disable=PCL013 -- key hashing only; asarray wraps non-array leaves (scalars), never pulls a device array
     """Deterministic (treedef, dtype, shape, sharding) signature of a
     concrete argument tuple -- what a compiled executable is
     specialized on. ``None`` subtrees are part of the treedef, so

@@ -33,6 +33,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 from typing import Optional
 
 import numpy as np
@@ -140,10 +141,10 @@ class SweepCoalescer:
     ``autoflush=False`` turns the coalescer into a pure queue for an
     external scheduler (the serving layer, ``pycatkin_tpu/serve``):
     ``submit`` never runs the solver inline; the owner polls
-    :meth:`due_keys`, pops ripe groups with :meth:`take_group` (safe
-    to call under a lock -- it only mutates dicts) and executes them
-    with :meth:`run_requests` wherever it likes (a worker thread, the
-    elastic queue). ``submit(..., wait_budget_s=...)`` tightens the
+    :meth:`due_keys`, pops ripe groups with :meth:`take_group`
+    (thread-safe: queue state lives behind the coalescer's own lock)
+    and executes them with :meth:`run_requests` wherever it likes (a
+    worker thread, the elastic queue). ``submit(..., wait_budget_s=...)`` tightens the
     group's flush deadline below ``max_wait_s`` per request -- the
     SLA-aware hook: a group's deadline is the EARLIEST budget of its
     members, so one latency-sensitive tenant flushes the whole pack
@@ -171,8 +172,15 @@ class SweepCoalescer:
         self.opts = opts
         self.pos_jac_tol = float(pos_jac_tol)
         self.autoflush = bool(autoflush)
-        self._groups: dict = {}
-        self._deadlines: dict = {}
+        # The queue dicts are shared between the serving loop's submit
+        # path and its executor threads (serve/server.py runs
+        # take_group/poll off-loop); the lock covers QUEUE STATE only
+        # -- no holder ever runs the solver or another locking method,
+        # so there is no nesting and flushes happen outside it. The
+        # '# guarded-by' contracts are enforced by pclint PCL011.
+        self._lock = threading.Lock()
+        self._groups: dict = {}      # guarded-by: _lock
+        self._deadlines: dict = {}   # guarded-by: _lock
         # Monotonic solo-group sequence: ``id(sim)`` is reusable after
         # GC, so two distinct unfittable sims submitted over a server's
         # lifetime could alias one key and silently co-flush.
@@ -218,18 +226,24 @@ class SweepCoalescer:
         req = PackedRequest(self, sim, spec, conds, tof_mask, x0, key,
                             submitted_at=_time.monotonic(),
                             wait_budget_s=wait_budget_s)
-        group = self._groups.setdefault(key, [])
-        group.append(req)
-        self._deadlines[key] = min(
-            self._deadlines.get(key, float("inf")),
-            self._deadline_for([req]))
-        if self.autoflush and len(group) >= self.max_occupancy:
+        with self._lock:
+            group = self._groups.setdefault(key, [])
+            group.append(req)
+            self._deadlines[key] = min(
+                self._deadlines.get(key, float("inf")),
+                self._deadline_for([req]))
+            should_flush = (self.autoflush
+                            and len(group) >= self.max_occupancy)
+        # Flush OUTSIDE the lock: flush_group -> take_group re-acquires
+        # it, and the runner must never execute under queue state.
+        if should_flush:
             self.flush_group(key)
         return req
 
     @property
     def pending(self) -> int:
-        return sum(len(g) for g in self._groups.values())
+        with self._lock:
+            return sum(len(g) for g in self._groups.values())
 
     def due_keys(self, now: Optional[float] = None) -> list:
         """Keys of every group ripe for flushing: at/over
@@ -239,11 +253,12 @@ class SweepCoalescer:
         that moved backwards -- simply reports nothing due."""
         import time as _time
         now = _time.monotonic() if now is None else now
-        due = [k for k, g in self._groups.items()
-               if len(g) >= self.max_occupancy]
-        for key, d in self._deadlines.items():
-            if now >= d and key not in due and key in self._groups:
-                due.append(key)
+        with self._lock:
+            due = [k for k, g in self._groups.items()
+                   if len(g) >= self.max_occupancy]
+            for key, d in self._deadlines.items():
+                if now >= d and key not in due and key in self._groups:
+                    due.append(key)
         return due
 
     def poll(self, now: Optional[float] = None) -> int:
@@ -252,8 +267,9 @@ class SweepCoalescer:
         this on its idle tick."""
         import time as _time
         now = _time.monotonic() if now is None else now
-        due = [k for k, d in self._deadlines.items()
-               if now >= d and self._groups.get(k)]
+        with self._lock:
+            due = [k for k, d in self._deadlines.items()
+                   if now >= d and self._groups.get(k)]
         for key in due:
             self.flush_group(key)
         return len(due)
@@ -261,7 +277,9 @@ class SweepCoalescer:
     def flush_all(self) -> int:
         """Flush every pending group regardless of age/occupancy."""
         flushed = 0
-        for key in list(self._groups):
+        with self._lock:
+            keys = list(self._groups)
+        for key in keys:
             reqs = self.take_group(key)
             if reqs:
                 self.run_requests(key, reqs)
@@ -271,23 +289,25 @@ class SweepCoalescer:
     def take_group(self, key, limit: Optional[int] = None) -> list:
         """Pop up to ``limit`` (all, if None) requests of one group,
         leaving any remainder queued with a recomputed deadline.
-        Mutates only the queue dicts -- never runs the solver -- so an
-        external scheduler may call it under a lock and execute the
-        returned requests elsewhere. Returns ``[]`` for a key already
-        taken (the benign half of a flush race)."""
-        reqs = self._groups.get(key)
-        if not reqs:
-            self._groups.pop(key, None)
-            self._deadlines.pop(key, None)
-            return []
-        if limit is None or len(reqs) <= limit:
-            self._groups.pop(key, None)
-            self._deadlines.pop(key, None)
-            return reqs
-        taken, rest = reqs[:limit], reqs[limit:]
-        self._groups[key] = rest
-        self._deadlines[key] = self._deadline_for(rest)
-        return taken
+        Mutates only the queue dicts under the coalescer's own lock --
+        never runs the solver -- so an external scheduler may call it
+        from any thread and execute the returned requests elsewhere.
+        Returns ``[]`` for a key already taken (the benign half of a
+        flush race)."""
+        with self._lock:
+            reqs = self._groups.get(key)
+            if not reqs:
+                self._groups.pop(key, None)
+                self._deadlines.pop(key, None)
+                return []
+            if limit is None or len(reqs) <= limit:
+                self._groups.pop(key, None)
+                self._deadlines.pop(key, None)
+                return reqs
+            taken, rest = reqs[:limit], reqs[limit:]
+            self._groups[key] = rest
+            self._deadlines[key] = self._deadline_for(rest)
+            return taken
 
     def run_requests(self, key, reqs) -> list:
         """Execute one taken group through ``runner`` NOW, resolve its

@@ -343,7 +343,10 @@ class WorkQueue:
 
     # -- shutdown ------------------------------------------------------
     def request_stop(self) -> None:
-        with open(os.path.join(self.root, _STOP), "w") as fh:
+        # Existence-only marker: readers test os.path.exists and never
+        # parse the content, so a torn write is indistinguishable from
+        # a complete one.
+        with open(os.path.join(self.root, _STOP), "w") as fh:  # pclint: disable=PCL012 -- existence-only stop marker; content never read
             fh.write("stop\n")
 
     def stop_requested(self) -> bool:
@@ -403,6 +406,11 @@ class _Heartbeat(threading.Thread):
         self.idx, self.ttl_s, self.interval_s = idx, ttl_s, interval_s
         self.lost = threading.Event()
         self._halt = threading.Event()
+        # Renewal bookkeeping is read by the OWNING worker thread (the
+        # done-record stamps how fresh its lease ran) while this thread
+        # writes it -- a lock, not an Event, so the count stays exact.
+        self._stats_lock = threading.Lock()
+        self._renewals = 0           # guarded-by: _stats_lock
 
     def run(self):
         from . import faults
@@ -413,6 +421,13 @@ class _Heartbeat(threading.Thread):
             if not self.queue.renew(self.tid, self.owner, self.ttl_s):
                 self.lost.set()
                 return
+            with self._stats_lock:
+                self._renewals += 1
+
+    def renewals(self) -> int:
+        """How many times this lease has been renewed so far."""
+        with self._stats_lock:
+            return self._renewals
 
     def halt(self):
         self._halt.set()
@@ -490,6 +505,7 @@ def _worker_main(cfg_path: str) -> None:
                 "tid": tid, "start": start, "stop": stop,
                 "status": "done", "owner": owner, "worker": idx,
                 "stolen_from": stolen_from,
+                "renewals": hb.renewals(),
                 "n_failed": int(np.sum(~np.asarray(out["success"],
                                                    dtype=bool)))})
         finally:

@@ -1,0 +1,78 @@
+"""pcsan: runtime sanitizers for the repo's performance contracts.
+
+The static rules (:mod:`pycatkin_tpu.lint`) catch the IDIOMS of
+contract violations; this package catches the violations themselves,
+at the moment they happen, with the failing program/operand/callback
+in the exception message. Three tripwires, all off unless
+``PYCATKIN_SAN=1`` (or a test/bench arms them explicitly):
+
+- **recompile sanitizer** (:mod:`.recompile`): after ``mark_warm()``,
+  any fresh XLA compile -- or any never-seen program key reaching the
+  dispatch seam -- raises :class:`RecompileSanError` naming the
+  program key and the operand whose shape/dtype churned the cache key.
+  The runtime teeth behind the zero-compile contract
+  (docs/serving.md's warm-cell gate).
+- **sync sanitizer** (:mod:`.syncs`): inside a ``strict()`` region,
+  a device->host pull (``np.asarray`` / ``np.array`` /
+  ``jax.device_get`` on a device array) that does not flow through the
+  counted ``utils.profiling.host_sync`` choke point raises
+  :class:`SyncSanError` at the pull site; a region budget bounds the
+  counted syncs too. The runtime teeth behind the single-sync budget
+  (``tests/test_sync_budget.py``).
+- **event-loop stall sanitizer** (:mod:`.stall`): asyncio's
+  slow-callback debug hook, armed on the serve loop with threshold
+  ``PYCATKIN_SAN_STALL_S`` (default 0.2 s); the ``watchdog()`` context
+  collects stall warnings and raises :class:`StallSanError` at exit.
+  The runtime teeth behind PCL010's lexical check.
+
+Wiring: ``make test-san`` runs the suite with ``PYCATKIN_SAN=1``
+(the pytest plugin :mod:`.plugin` arms everything), ``bench.py
+--smoke`` runs its smoke sweep under all three and reports ``san_ok``,
+and :class:`serve.server.SweepServer` arms the recompile + stall
+sanitizers on its own loop when enabled. Known runtime blind spot:
+``float(x)``/``int(x)`` scalar pulls bypass every patchable seam --
+PCL001 owns those statically.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV = "PYCATKIN_SAN"
+
+
+def enabled() -> bool:
+    """True when ``PYCATKIN_SAN`` asks for the sanitizer layer."""
+    return os.environ.get(ENV, "").lower() in ("1", "on", "true", "yes")
+
+
+class SanError(RuntimeError):
+    """Base of every sanitizer trip (never raised itself)."""
+
+
+class RecompileSanError(SanError):
+    """A compile (or never-seen program key) surfaced after
+    ``mark_warm()`` -- the zero-compile contract broke."""
+
+
+class SyncSanError(SanError):
+    """An uncounted or over-budget device->host pull inside a strict
+    sync region -- the single-sync contract broke."""
+
+
+class StallSanError(SanError):
+    """A callback held the event loop past the stall threshold -- the
+    non-blocking serve contract broke."""
+
+
+def install() -> None:
+    """Arm every passive sanitizer (idempotent): the sync patches
+    record-and-check only inside ``strict()`` regions, the recompile
+    recorder only trips after ``mark_warm()``."""
+    from . import recompile, syncs
+    syncs.install()
+    recompile.activate()
+
+
+__all__ = ["ENV", "enabled", "install", "SanError", "RecompileSanError",
+           "SyncSanError", "StallSanError"]

@@ -1,0 +1,24 @@
+"""pytest plugin arming the sanitizer layer (loaded via
+``pytest_plugins`` in ``tests/conftest.py``).
+
+Under ``PYCATKIN_SAN=1`` (the ``make test-san`` lane) this installs
+the passive halves at session start: the sync-seam patches (inert
+outside ``strict()`` regions) and the recompile recorder (inert until
+``mark_warm()``). Tests that drive a tripwire on purpose carry the
+``san`` marker so the lane can be selected with ``-m san``; everything
+else runs undisturbed -- that the ordinary suite stays green under the
+armed sanitizers is itself part of the acceptance contract.
+"""
+
+from __future__ import annotations
+
+from . import enabled, install
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "san: sanitizer selftests (tripwire injection; run via "
+        "'make test-san' or -m san)")
+    if enabled():
+        install()

@@ -1,0 +1,137 @@
+"""Recompile sanitizer: the zero-compile contract, enforced at runtime.
+
+A warm serving cell must never compile: every program it can dispatch
+was built during warmup (``SweepServer.warm`` / the AOT pack import),
+and a compile after ``mark_warm()`` is a 10-60 s latency cliff hiding
+behind one unlucky request. The serving layer already *measures* this
+(the zero-compile-rate gate); this sanitizer makes the first violation
+LOUD and attributed instead of a statistic:
+
+- :func:`note_program` sits on the dispatch seam
+  (``parallel.batch._registered_call`` -- every solo/packed/fused
+  program passes through it). While warming it records each program
+  key with its operand shape signature; after :func:`mark_warm`, a
+  never-seen key raises :class:`~pycatkin_tpu.san.RecompileSanError`
+  naming the program kind, the key, and the first operand leaf whose
+  shape/dtype/sharding differs from the nearest warm signature -- the
+  operand that churned the cache key.
+- :func:`note_compile` sits on the two explicit ``lower().compile()``
+  sites (packed flush, prewarm pool); after :func:`mark_warm` any
+  fresh XLA compile raises, whatever its key.
+
+Everything is a no-op until :func:`activate` (the pytest plugin, the
+serve layer and ``bench.py --smoke`` call it when ``PYCATKIN_SAN`` is
+on): one module-bool check per dispatch when cold.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import RecompileSanError
+
+_lock = threading.Lock()
+_active = False
+_warm = False
+_seen: dict = {}        # kind -> {key: shape signature}
+
+
+def activate() -> None:
+    global _active
+    _active = True
+
+
+def deactivate() -> None:
+    global _active
+    _active = False
+
+
+def reset() -> None:
+    """Back to cold: forget every recorded program and the warm mark
+    (tests; also the right call after an intentional re-warm)."""
+    global _warm
+    with _lock:
+        _warm = False
+        _seen.clear()
+
+
+def mark_warm() -> None:
+    """Declare warmup over: from here on, new program keys and fresh
+    compiles raise."""
+    global _warm
+    with _lock:
+        _warm = True
+
+
+def is_warm() -> bool:
+    return _warm
+
+
+def is_active() -> bool:
+    return _active
+
+
+def _signature(args) -> str:
+    from ..parallel import compile_pool
+    return compile_pool._shape_signature(args)
+
+
+def _diff_operand(kind: str, sig: str) -> str:
+    """Human-readable locator of the operand that churned the key:
+    compare the tripping signature against every warm signature of the
+    same kind and report the first differing leaf of the closest
+    match (same leaf count preferred)."""
+    new_parts = sig.split("|")
+    candidates = [s.split("|") for s in _seen.get(kind, {}).values()]
+    if not candidates:
+        return "no warm program of this kind was ever recorded"
+    same_len = [c for c in candidates if len(c) == len(new_parts)]
+    if not same_len:
+        return (f"operand tree shape changed: {len(new_parts) - 1} "
+                f"leaves vs {sorted({len(c) - 1 for c in candidates})} "
+                f"in every warm signature of this kind")
+    best, best_eq = None, -1
+    for c in same_len:
+        eq = sum(a == b for a, b in zip(c, new_parts))
+        if eq > best_eq:
+            best, best_eq = c, eq
+    if best[0] != new_parts[0]:
+        return "operand treedef changed (argument structure, not shapes)"
+    for i, (old, new) in enumerate(zip(best[1:], new_parts[1:])):
+        if old != new:
+            return (f"operand leaf {i} churned the cache key: warm saw "
+                    f"{old}, this call carries {new}")
+    return "signature differs only in its treedef repr"
+
+
+def note_program(kind: str, key: str, args) -> None:
+    """Dispatch-seam hook: record (cold) or verify (warm) one program
+    key. Called by ``parallel.batch._registered_call`` on EVERY
+    registered-program dispatch."""
+    if not _active:
+        return
+    with _lock:
+        kinds = _seen.setdefault(kind, {})
+        if key in kinds:
+            return
+        if not _warm:
+            kinds[key] = _signature(args)
+            return
+        detail = _diff_operand(kind, _signature(args))
+    raise RecompileSanError(
+        f"recompile sanitizer: program kind {kind!r} reached the "
+        f"dispatch seam with never-seen key {key[:16]}... after "
+        f"mark_warm() -- this call will trace+compile in-band on a "
+        f"warm cell; {detail}")
+
+
+def note_compile(label: str) -> None:
+    """Compile-site hook: a fresh XLA compile is about to run. Raises
+    when the cell is warm (whatever the key -- a warm cell compiles
+    nothing)."""
+    if not _active or not _warm:
+        return
+    raise RecompileSanError(
+        f"recompile sanitizer: fresh XLA compile ({label}) after "
+        f"mark_warm() -- a warm cell must dispatch only prebuilt "
+        f"executables (warm more programs, or widen the AOT pack)")

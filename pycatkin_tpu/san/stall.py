@@ -1,0 +1,92 @@
+"""Event-loop stall sanitizer: the serve loop's non-blocking contract.
+
+PCL010 lexically bans blocking calls inside ``serve/`` async bodies,
+but a stall can arrive through anything the lexical net cannot see --
+a library call that blocks internally, a "fast" computation that is
+not, an offload someone forgot. asyncio already HAS the detector:
+debug mode times every callback/task step and logs a warning when one
+holds the loop longer than ``loop.slow_callback_duration``. This
+module turns that warning into a hard failure:
+
+- :func:`arm` (await it on the loop under test, or let
+  ``SweepServer.start`` do it when ``PYCATKIN_SAN=1``) enables debug
+  mode and sets the threshold from ``PYCATKIN_SAN_STALL_S``
+  (default 0.2 s);
+- :func:`watchdog` wraps the test body, captures asyncio's
+  "Executing <Handle/Task ...> took N seconds" warnings via a logging
+  handler, and raises :class:`~pycatkin_tpu.san.StallSanError` at
+  exit quoting every stalled callback.
+
+The split matters: the warning fires INSIDE the loop (where raising
+would land in asyncio's internals), the raise happens at the
+test/bench seam where it can fail the right unit of work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import re
+
+from . import StallSanError
+
+STALL_ENV = "PYCATKIN_SAN_STALL_S"
+_DEFAULT_STALL_S = 0.2
+
+# asyncio/base_events.py emits exactly this shape in debug mode.
+_STALL_RE = re.compile(r"Executing .* took .* seconds")
+
+
+def threshold_s() -> float:
+    """The stall threshold (``PYCATKIN_SAN_STALL_S``, seconds)."""
+    try:
+        return float(os.environ.get(STALL_ENV, _DEFAULT_STALL_S))
+    except ValueError:
+        return _DEFAULT_STALL_S
+
+
+async def arm(stall_s=None) -> float:
+    """Enable slow-callback detection on the RUNNING loop; returns the
+    threshold applied."""
+    import asyncio
+    loop = asyncio.get_running_loop()
+    loop.set_debug(True)
+    s = threshold_s() if stall_s is None else float(stall_s)
+    loop.slow_callback_duration = s
+    return s
+
+
+class _StallHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.stalls: list = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if _STALL_RE.search(msg):
+            self.stalls.append(msg)
+
+
+@contextlib.contextmanager
+def watchdog(raise_on_stall: bool = True):
+    """Capture slow-callback warnings from any loop armed inside the
+    block; yields the handler (``.stalls`` is the evidence list) and
+    raises :class:`StallSanError` at exit when any callback stalled."""
+    logger = logging.getLogger("asyncio")
+    handler = _StallHandler()
+    logger.addHandler(handler)
+    # Debug-mode warnings are dropped before reaching handlers if the
+    # asyncio logger's effective level is above WARNING.
+    prior_level = logger.level
+    if logger.getEffectiveLevel() > logging.WARNING:
+        logger.setLevel(logging.WARNING)
+    try:
+        yield handler
+    finally:
+        logger.setLevel(prior_level)
+        logger.removeHandler(handler)
+    if handler.stalls and raise_on_stall:
+        raise StallSanError(
+            "event-loop stall sanitizer: callback(s) held the serve "
+            "loop past its threshold:\n  " + "\n  ".join(handler.stalls))

@@ -1,0 +1,154 @@
+"""Sync sanitizer: the single-sync budget, enforced at the pull site.
+
+``tests/test_sync_budget.py`` proves a clean sweep stays within
+``MAX_CLEAN_SYNCS`` *counted* materializations -- but a raw
+``np.asarray(device_array)`` somewhere off the counted choke point is
+invisible to the counter: it silently re-adds the ~1 s tunnel round
+trip the whole architecture exists to avoid. This sanitizer patches
+the three pull seams a device array can cross --
+
+- ``numpy.asarray`` / ``numpy.array`` (callers resolve them through
+  the module dict at call time, so the patch intercepts every
+  ``np.asarray(...)`` in the tree),
+- ``jax.device_get``,
+
+-- and inside a :func:`strict` region raises
+:class:`~pycatkin_tpu.san.SyncSanError` the moment one of them
+receives a device array WITHOUT flowing through
+``utils.profiling.host_sync`` (which wraps its materialization in
+:func:`counted`). The region also takes an optional budget: counted
+syncs beyond it raise at the ``host_sync`` call site with the label
+trail of everything already spent.
+
+Patching is process-global but PASSIVE: outside a strict region the
+wrappers forward immediately (one ContextVar read), so installing
+under ``PYCATKIN_SAN=1`` does not perturb the rest of the suite.
+
+Known blind spot: ``float(x)`` / ``int(x)`` on a device scalar pulls
+through ``Array.__float__``, which offers no patchable module seam --
+PCL001 catches that idiom statically on the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+
+from . import SyncSanError
+
+# Active strict region, or None. The dict is the region's mutable
+# state: {"label", "budget", "count", "labels"}.
+_strict: contextvars.ContextVar = contextvars.ContextVar(
+    "pycatkin_san_strict", default=None)
+# True while utils.profiling.host_sync is materializing: its pulls are
+# the counted, sanctioned ones.
+_counted: contextvars.ContextVar = contextvars.ContextVar(
+    "pycatkin_san_counted", default=False)
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _is_device_value(x) -> bool:
+    """True when ``x`` is (or contains, for small containers) a JAX
+    device array -- the payloads whose pull costs a tunnel round
+    trip."""
+    try:
+        import jax
+    except Exception:
+        return False
+    if isinstance(x, jax.Array):
+        return True
+    if isinstance(x, (tuple, list)):
+        return any(isinstance(v, jax.Array) for v in x)
+    if isinstance(x, dict):
+        return any(isinstance(v, jax.Array) for v in x.values())
+    return False
+
+
+def _trip(seam: str) -> None:
+    region = _strict.get()
+    raise SyncSanError(
+        f"sync sanitizer: uncounted device->host pull via {seam} "
+        f"inside strict region {region['label']!r} -- route it "
+        f"through utils.profiling.host_sync (counted) or move it off "
+        f"the hot path; counted so far: {region['labels']}")
+
+
+def _guard(orig, seam: str):
+    def wrapper(x, *args, **kwargs):
+        if (_strict.get() is not None and not _counted.get()
+                and _is_device_value(x)):
+            _trip(seam)
+        return orig(x, *args, **kwargs)
+    wrapper.__name__ = getattr(orig, "__name__", seam)
+    wrapper.__wrapped__ = orig
+    return wrapper
+
+
+def install() -> None:
+    """Patch the pull seams (idempotent, process-global, passive
+    outside strict regions)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import numpy
+        numpy.asarray = _guard(numpy.asarray, "np.asarray")
+        numpy.array = _guard(numpy.array, "np.array")
+        try:
+            import jax
+            jax.device_get = _guard(jax.device_get, "jax.device_get")
+        except Exception:
+            pass
+        _installed = True
+
+
+def installed() -> bool:
+    return _installed
+
+
+@contextlib.contextmanager
+def counted():
+    """Mark the enclosed pulls as flowing through the counted choke
+    point (used by ``utils.profiling.host_sync`` only)."""
+    token = _counted.set(True)
+    try:
+        yield
+    finally:
+        _counted.reset(token)
+
+
+def note_counted_sync(label: str) -> None:
+    """Budget hook, called by ``host_sync`` per counted sync (when the
+    sanitizer layer is enabled): over-budget counted syncs raise at
+    the host_sync call site, label trail attached."""
+    region = _strict.get()
+    if region is None:
+        return
+    region["count"] += 1
+    region["labels"].append(label or "<unlabeled>")
+    budget = region["budget"]
+    if budget is not None and region["count"] > budget:
+        raise SyncSanError(
+            f"sync sanitizer: counted sync #{region['count']} "
+            f"({label!r}) exceeds the strict region "
+            f"{region['label']!r} budget of {budget}; spent on: "
+            f"{region['labels']}")
+
+
+@contextlib.contextmanager
+def strict(budget=None, label: str = "strict-sync"):
+    """Arm the sanitizer for the enclosed region: uncounted device
+    pulls raise immediately; counted syncs beyond ``budget`` (None =
+    unlimited) raise at the choke point. Yields the region state dict
+    (``count`` / ``labels``) for assertions."""
+    install()
+    region = {"label": label, "budget": budget, "count": 0,
+              "labels": []}
+    token = _strict.set(region)
+    try:
+        yield region
+    finally:
+        _strict.reset(token)

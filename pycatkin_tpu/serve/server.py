@@ -118,6 +118,15 @@ class SweepServer:
         manifest, start the scheduler loop and (optionally) the TCP
         listener. Cold-start work happens HERE, before the first
         request can arrive."""
+        from .. import san as _san
+        if _san.enabled():
+            # Arm the sanitizer layer on the serve loop: slow-callback
+            # detection (stall sanitizer) plus the passive sync/
+            # recompile recorders. mark_warm() later arms the
+            # recompile TRIPWIRE on top of the recorder.
+            from ..san import stall as _san_stall
+            _san.install()
+            await _san_stall.arm()
         self._coalescer = self._make_coalescer()
         self._wake = asyncio.Event()
         if self.config.aot_pack:
@@ -170,11 +179,19 @@ class SweepServer:
 
     def mark_warm(self) -> None:
         """Declare warmup over: flush/compile counters accumulated
-        after this call feed the zero-compile-rate gate."""
+        after this call feed the zero-compile-rate gate. Under
+        ``PYCATKIN_SAN=1`` this also arms the recompile sanitizer's
+        tripwire: from here on a fresh compile (or a never-seen
+        program key at the dispatch seam) RAISES instead of just
+        moving the rate."""
         self._warm_marked = True
         self.flushes_after_warm = 0
         self.flushes_with_compiles_after_warm = 0
         self.compiles_after_warm = 0.0
+        from .. import san as _san
+        if _san.enabled():
+            from ..san import recompile as _san_recompile
+            _san_recompile.mark_warm()
 
     # -- shutdown ------------------------------------------------------
 

@@ -508,7 +508,7 @@ def _lm_attempt(fscale_fn, jac_fn, x0, groups_dyn, opts: SolverOptions):
     return x, fnorm, k, lam, jnp.zeros((), dtype=jnp.int32)
 
 
-def bulk_options(opts: SolverOptions, tier: str) -> SolverOptions:
+def bulk_options(opts: SolverOptions, tier: str) -> SolverOptions:  # pclint: disable=PCL013 -- float(jnp.finfo(...).eps) is dtype metadata, no device value crosses
     """Tolerances the reduced-precision BULK march can actually reach.
 
     The f64 convergence test divides by ``rate_tol + rate_tol_rel *

@@ -14,6 +14,7 @@ with reference data trees. Sweep results checkpoint as ``.npz`` bundles.
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
@@ -28,8 +29,10 @@ def save_state_energy(state, path: str):
     readable by energy_source='datafile')."""
     state.load()
     assert state.Gelec is not None, f"state {state.name} has no energy"
-    with open(path, "w") as fh:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         fh.write(f"{state.Gelec:.15e} eV\n")
+    os.replace(tmp, path)
 
 
 def save_state_vibrations(state, path: str):
@@ -37,7 +40,8 @@ def save_state_vibrations(state, path: str):
     state.py:229-245 save_vibrations; readable by
     freq_source='datafile')."""
     state.load()
-    with open(path, "w") as fh:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         k = 0
         for f in np.asarray(state.freq).ravel():
             fh.write(f"{k} f = {f:.15e} Hz\n")
@@ -46,6 +50,7 @@ def save_state_vibrations(state, path: str):
                             else []).ravel():
             fh.write(f"{k} f/i = {f:.15e} Hz\n")
             k += 1
+    os.replace(tmp, path)
 
 
 def _state_cfg(st, sname=None) -> dict:
@@ -267,9 +272,13 @@ def system_to_dict(sim) -> dict:
 
 
 def save_system_json(sim, path: str):
-    """Checkpoint a System as a reference-schema JSON input file."""
-    with open(path, "w") as fh:
+    """Checkpoint a System as a reference-schema JSON input file
+    (tmp + ``os.replace``: a concurrent reader -- or a reload after a
+    mid-write kill -- never parses a torn checkpoint)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         json.dump(system_to_dict(sim), fh, indent=1)
+    os.replace(tmp, path)
 
 
 def save_results(path: str, **arrays):

@@ -19,6 +19,7 @@ complete the scalar without executing the whole program chain, and only
 
 from __future__ import annotations
 
+import contextlib
 import cProfile
 import io
 import pstats
@@ -30,6 +31,7 @@ import numpy as np
 
 from ..obs import metrics as _metrics
 from ..obs import trace as _obs
+from .. import san as _san
 
 
 # ---------------------------------------------------------------------
@@ -99,7 +101,7 @@ _SYNC_COUNT = 0
 _SYNC_LABELS: list = []
 
 
-def host_sync(value, label: str = ""):
+def host_sync(value, label: str = ""):  # pclint: disable=PCL013 -- this IS the counted sync choke point the budget measures
     """Materialize ``value`` onto the host (the blocking sync point) and
     count it ONCE. ``value`` is usually a single array (returns the
     numpy array, the historical contract); a tuple/list/dict of arrays
@@ -118,17 +120,28 @@ def host_sync(value, label: str = ""):
     _obs.note_sync(label)
     _metrics.counter("pycatkin_host_syncs_total",
                      "counted blocking device->host syncs").inc()
+    # Sanitizer seam (pcsan, PYCATKIN_SAN=1): inside a strict sync
+    # region the budget check raises HERE -- the counted call site --
+    # and the pulls below run flagged as counted so the patched
+    # np.asarray/device_get seams wave them through.
+    if _san.enabled():
+        from ..san import syncs as _san_syncs
+        _san_syncs.note_counted_sync(label)
+        counted_cm = _san_syncs.counted()
+    else:
+        counted_cm = contextlib.nullcontext()
     # The materialization below is the actual blocking window: its
     # duration (not just its count) is what the tunnel bills, so it is
     # histogrammed per label -- sync COST is budgetable alongside sync
     # count (docs/observability.md).
     t0 = time.perf_counter()
     try:
-        if isinstance(value, (tuple, list, dict)):
-            import jax
-            return jax.tree_util.tree_map(np.asarray,
-                                          jax.device_get(value))
-        return np.asarray(value)
+        with counted_cm:
+            if isinstance(value, (tuple, list, dict)):
+                import jax
+                return jax.tree_util.tree_map(np.asarray,
+                                              jax.device_get(value))
+            return np.asarray(value)
     finally:
         _metrics.histogram(
             "pycatkin_host_sync_seconds",

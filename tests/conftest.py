@@ -28,6 +28,10 @@ enable_persistent_cache()
 
 import pytest  # noqa: E402
 
+# Sanitizer layer (pcsan): registers the `san` marker; arms the
+# tripwires when the PYCATKIN_SAN env knob is on (make test-san).
+pytest_plugins = ("pycatkin_tpu.san.plugin",)
+
 REFERENCE_ROOT = os.environ.get("PYCATKIN_REFERENCE_ROOT", "/root/reference")
 
 

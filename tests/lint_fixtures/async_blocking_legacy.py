@@ -1,0 +1,60 @@
+"""Seeded PCL010 violations: blocking calls inside ``async def``
+bodies. Never imported; the serve/ scope is bypassed on purpose by
+``lint_file``."""
+
+import asyncio
+import time
+
+import numpy as np
+
+from pycatkin_tpu.utils.profiling import host_sync
+
+
+async def sleepy_handler():
+    time.sleep(0.1)                 # VIOLATION: blocks the loop
+
+
+async def file_reader(path):
+    with open(path) as fh:          # VIOLATION: blocking file I/O
+        return fh.read()
+
+
+async def future_waiter(fut, thread):
+    x = fut.result()                # VIOLATION: blocks on a future
+    thread.join()                   # VIOLATION: no-arg thread join
+    return x
+
+
+async def device_puller(arr):
+    return np.asarray(arr)          # VIOLATION: device pull on the loop
+
+
+async def counted_puller(arr):
+    return host_sync(arr, "serve")  # VIOLATION: counted, still blocking
+
+
+async def sanctioned(arr, path):
+    # Offload is the sanctioned idiom: the blocking callable runs on a
+    # worker thread, the loop only awaits.
+    data = await asyncio.to_thread(np.asarray, arr)
+    await asyncio.sleep(0.01)       # async sleep: clean
+    sep = ",".join(str(x) for x in data)     # string join: clean
+    return sep, path
+
+
+async def reviewed_blocking(path):
+    with open(path) as fh:  # pclint: disable=PCL010 -- startup-only config read, loop not serving yet
+        return fh.read()
+
+
+def sync_helper(arr):
+    # Sync def: runs wherever it is invoked (a worker thread here);
+    # not the loop's problem.
+    time.sleep(0.1)
+    return np.asarray(arr)
+
+
+async def with_nested_sync_def(arr):
+    def offloaded():
+        return np.asarray(arr)      # nested sync def: clean
+    return await asyncio.to_thread(offloaded)

@@ -1,0 +1,39 @@
+"""Seeded PCL012 violations: torn-write idioms in a protocol file.
+Never imported; the scheduler/io scope is bypassed on purpose by
+``lint_file``."""
+
+import json
+import os
+
+
+def torn_record(path, payload):
+    with open(path, "w") as fh:     # VIOLATION: no atomic publish
+        json.dump(payload, fh)
+
+
+def clobbering_rename(src, dst):
+    os.rename(src, dst)             # VIOLATION: use os.replace/os.link
+
+
+def atomic_record(path, payload):
+    # Clean: tmp + os.replace (last-writer-wins publish).
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+
+
+def first_wins_record(path, payload):
+    # Clean: tmp + os.link (first-writer-wins publish).
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    try:
+        os.link(tmp, path)
+    finally:
+        os.unlink(tmp)
+
+
+def marker_file(path):
+    with open(path, "w") as fh:  # pclint: disable=PCL012 -- existence-only marker; content never read
+        fh.write("x\n")

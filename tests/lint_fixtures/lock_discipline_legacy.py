@@ -1,0 +1,31 @@
+"""Seeded PCL011 violations: guarded attributes touched outside their
+lock. Never imported."""
+
+import threading
+
+
+class LeakyQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []      # guarded-by: _lock
+        self._count = 0             # guarded-by: _lock
+        self._free: list = []       # no contract: never flagged
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)   # clean: lock held
+            self._count += 1        # clean: lock held
+
+    def racy_pop(self):
+        if self._items:             # VIOLATION: read outside the lock
+            return self._items.pop()  # VIOLATION: write outside the lock
+        return None
+
+    def racy_count(self):
+        return self._count          # VIOLATION: read outside the lock
+
+    def free_for_all(self):
+        return list(self._free)     # clean: undeclared attribute
+
+    def approx_len(self):
+        return len(self._items)  # pclint: disable=PCL011 -- benign racy read for progress display

@@ -1,0 +1,25 @@
+"""Seeded PCL009 violations: instrument names missing from the
+metrics catalog. The paired test checks against a doc documenting ONLY
+`pycatkin_documented_total`. Never imported."""
+
+from pycatkin_tpu.obs import metrics as _metrics
+
+
+def documented_metric():
+    _metrics.counter("pycatkin_documented_total",
+                     "in the catalog; clean").inc()
+
+
+def undocumented_counter():
+    # VIOLATION: name absent from the catalog table.
+    _metrics.counter("pycatkin_rogue_total", "nobody will find me").inc()
+
+
+def undocumented_histogram():
+    # VIOLATION: histograms are checked too.
+    _metrics.histogram("pycatkin_rogue_seconds", "orphaned").observe(1.0)
+
+
+def reviewed_scratch_metric():
+    _metrics.gauge("pycatkin_scratch_items",  # pclint: disable=PCL009 -- scratch gauge for the fixture corpus
+                   "inline-suppressed").set(1.0)

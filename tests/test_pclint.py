@@ -27,14 +27,19 @@ from pycatkin_tpu.lint import baseline
 from pycatkin_tpu.lint import core
 from pycatkin_tpu.lint.abi_capture import (SPEC_ARRAY_FIELDS,
                                            AbiCaptureChecker)
+from pycatkin_tpu.lint.async_blocking import AsyncBlockingChecker
+from pycatkin_tpu.lint.atomic_write import AtomicWriteChecker
 from pycatkin_tpu.lint.core import Finding, checkers_for, lint_file, run_lint
 from pycatkin_tpu.lint.dtype import DtypeChecker
 from pycatkin_tpu.lint.env_registry import EnvRegistryChecker
 from pycatkin_tpu.lint.event_kinds import EventKindChecker
 from pycatkin_tpu.lint.fault_sites import FaultSiteChecker
+from pycatkin_tpu.lint.fused_tail import FusedTailChecker
 from pycatkin_tpu.lint.host_sync import HostSyncChecker, collect_syncs
 from pycatkin_tpu.lint.hotpath import (HOT_FUNCTIONS, HOT_PATH_FILES,
                                        MAX_CLEAN_SYNCS)
+from pycatkin_tpu.lint.lock_discipline import LockDisciplineChecker
+from pycatkin_tpu.lint.metric_names import MetricNameChecker
 from pycatkin_tpu.lint.purity import JitPurityChecker
 from pycatkin_tpu.lint.tracer import TracerLeakChecker
 
@@ -69,6 +74,15 @@ def _event_checker(tmp_path):
     doc.write_text("Known kinds: `span`, `degradation`.\n",
                    encoding="utf-8")
     return EventKindChecker(doc_path=str(doc))
+
+
+def _metric_checker(tmp_path):
+    """PCL009 against a catalog documenting only
+    `pycatkin_documented_total`."""
+    doc = tmp_path / "observability.md"
+    doc.write_text("Catalog: `pycatkin_documented_total`.\n",
+                   encoding="utf-8")
+    return MetricNameChecker(doc_path=str(doc))
 
 
 # ---------------------------------------------------------------- PCL001
@@ -287,6 +301,10 @@ _FIXTURE_MATRIX = [
     ("PCL006", lambda tmp: EnvRegistryChecker(), "env_legacy.py"),
     ("PCL007", lambda tmp: AbiCaptureChecker(), "abi_capture_legacy.py"),
     ("PCL008", _event_checker, "event_kinds_legacy.py"),
+    ("PCL009", _metric_checker, "metric_legacy.py"),
+    ("PCL010", lambda tmp: AsyncBlockingChecker(), "async_blocking_legacy.py"),
+    ("PCL011", lambda tmp: LockDisciplineChecker(), "lock_discipline_legacy.py"),
+    ("PCL012", lambda tmp: AtomicWriteChecker(), "atomic_write_legacy.py"),
 ]
 
 
@@ -398,3 +416,203 @@ def test_json_and_sarif_outputs_parse():
     assert sarif["version"] == "2.1.0"
     rules = sarif["runs"][0]["tool"]["driver"]["rules"]
     assert {r["id"] for r in rules} >= {"PCL003", "PCL004", "PCL005"}
+
+
+def test_cli_no_cache_flag_still_exits_zero():
+    proc = _run_pclint("--no-cache")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------- PCL013 (cross-module pass)
+
+# A miniature package tree: the decorated sweep body reaches one direct
+# leak, one leak two hops down, one clean helper, and one def-line
+# suppression. PCL013 is the only rule that needs a whole TREE (not a
+# single fixture file) because its evidence is the call graph.
+_MINI_BATCH = '''\
+import jax.numpy as jnp
+import numpy as np
+
+from pycatkin_tpu.lint.hotpath import hotpath
+
+
+def _leaky_tail(x):
+    return np.asarray(x)
+
+
+def _clean_helper(x):
+    return _deep_leak(x) + 1
+
+
+def _deep_leak(x):
+    return float(jnp.sum(x))
+
+
+def _reviewed_tail(x):  # pclint: disable=PCL013 -- host-side numpy conversion, no device round trip
+    return np.asarray(x)
+
+
+@hotpath
+def fused_sweep(x):
+    y = _clean_helper(x)
+    return _leaky_tail(y) + _reviewed_tail(y)
+'''
+
+
+def _mini_tree(tmp_path):
+    pkg = tmp_path / "pycatkin_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pycatkin_tpu" / "__init__.py").write_text(
+        "", encoding="utf-8")
+    (pkg / "__init__.py").write_text("", encoding="utf-8")
+    (pkg / "batch.py").write_text(_MINI_BATCH, encoding="utf-8")
+    return str(tmp_path)
+
+
+def test_fused_tail_flags_reachable_undecorated_syncs(tmp_path):
+    root = _mini_tree(tmp_path)
+    result = run_lint(root=root, checkers=[FusedTailChecker()])
+    act = active(result.findings)
+    flagged = sorted(f.message.split("`")[1] for f in act)
+    # direct callee AND the two-hop callee; never the clean helper or
+    # the decorated root itself
+    assert flagged == ["_deep_leak", "_leaky_tail"], \
+        [f.message for f in result.findings]
+    sup = inline(result.findings)
+    assert len(sup) == 1 and "_reviewed_tail" in sup[0].message
+    assert "host-side numpy conversion" in sup[0].reason
+    assert all(f.rule == "PCL013" for f in result.findings)
+
+
+def test_fused_tail_silent_once_decorated(tmp_path):
+    root = _mini_tree(tmp_path)
+    fixed = _MINI_BATCH.replace(
+        "def _leaky_tail", "@hotpath\ndef _leaky_tail").replace(
+        "def _deep_leak", "@hotpath\ndef _deep_leak")
+    (tmp_path / "pycatkin_tpu" / "parallel" / "batch.py").write_text(
+        fixed, encoding="utf-8")
+    result = run_lint(root=root, checkers=[FusedTailChecker()])
+    assert not active(result.findings), \
+        [f.message for f in result.findings]
+
+
+def test_hotpath_runtime_registry_matches_static_scan():
+    """Satellite 4 drift gate, both directions: every function
+    decorated at runtime lives in a scanned file under its static
+    name, and every statically scanned name is actually decorated in
+    the imported module (a decorator deleted at runtime but left in a
+    stale scan would silently drop enforcement)."""
+    import pycatkin_tpu.parallel.batch  # noqa: F401 -- fills registry
+    from pycatkin_tpu.lint.hotpath import (HOT_PATH_SCAN_FILES,
+                                           runtime_registry)
+    runtime = runtime_registry()
+    assert runtime, "no @hotpath decorations registered at import"
+    for mod, qual in runtime:
+        rel = mod.replace(".", "/") + ".py"
+        assert rel in HOT_PATH_SCAN_FILES, (
+            f"{mod}.{qual} is @hotpath-decorated but {rel} is not in "
+            f"HOT_PATH_SCAN_FILES -- invisible to the static side")
+        assert qual in HOT_PATH_FILES[rel], (mod, qual)
+    runtime_names = {qual for _, qual in runtime}
+    for rel, names in HOT_PATH_FILES.items():
+        assert names <= runtime_names, names - runtime_names
+
+
+# ----------------------------------------------------------- lint cache
+
+def _cache_tree(tmp_path):
+    pkg = tmp_path / "pycatkin_tpu" / "solvers"   # in DtypeChecker scope
+    pkg.mkdir(parents=True)
+    (tmp_path / "pycatkin_tpu" / "__init__.py").write_text(
+        "", encoding="utf-8")
+    (pkg / "__init__.py").write_text("", encoding="utf-8")
+    (pkg / "mod.py").write_text(
+        "import numpy as np\nx = np.float64(1.0)\n", encoding="utf-8")
+    return str(tmp_path)
+
+
+def _cached_run(root, cache):
+    return run_lint(root=root,
+                    checkers=[DtypeChecker(), FusedTailChecker()],
+                    cache=cache)
+
+
+def test_cache_warm_hit_returns_identical_findings(tmp_path):
+    from dataclasses import asdict
+
+    from pycatkin_tpu.lint.cache import LintCache
+    root = _cache_tree(tmp_path)
+    c1 = LintCache(root)
+    r1 = _cached_run(root, c1)
+    assert c1.hits == 0 and c1.misses >= 2   # file + project entries
+    assert len(active(r1.findings)) == 1
+    c1.save()
+
+    c2 = LintCache(root)
+    r2 = _cached_run(root, c2)
+    assert c2.misses == 0 and c2.hits >= 2
+    assert ([asdict(f) for f in r1.findings]
+            == [asdict(f) for f in r2.findings])
+
+
+def test_cache_invalidates_on_file_edit(tmp_path):
+    from pycatkin_tpu.lint.cache import LintCache
+    root = _cache_tree(tmp_path)
+    c1 = LintCache(root)
+    _cached_run(root, c1)
+    c1.save()
+
+    # The edit must miss BOTH the per-file entry and the project-level
+    # (PCL013) entry -- any package change re-keys the index pass.
+    (tmp_path / "pycatkin_tpu" / "solvers" / "mod.py").write_text(
+        "import numpy as np\n"
+        "x = np.float64(1.0)\n"
+        "y = np.float64(2.0)\n", encoding="utf-8")
+    c2 = LintCache(root)
+    r2 = _cached_run(root, c2)
+    # edited file + project entry miss; the untouched __init__ still hits
+    assert c2.misses >= 2
+    assert len(active(r2.findings)) == 2
+
+
+def test_cache_salt_invalidates_on_registry_doc_change(tmp_path):
+    from pycatkin_tpu.lint.cache import LintCache
+    root = _cache_tree(tmp_path)
+    c1 = LintCache(root)
+    _cached_run(root, c1)
+    c1.save()
+
+    # docs/*.md feed the salt (doc-backed registries): the whole cache
+    # goes cold even though no Python file changed.
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text("`m`\n", encoding="utf-8")
+    c2 = LintCache(root)
+    _cached_run(root, c2)
+    assert c2.hits == 0 and c2.misses >= 2
+
+
+def test_cache_disabled_reads_and_writes_nothing(tmp_path):
+    from pycatkin_tpu.lint.cache import LintCache
+    root = _cache_tree(tmp_path)
+    c = LintCache(root, enabled=False)
+    r = _cached_run(root, c)
+    c.save()
+    assert active(r.findings)
+    assert not os.path.exists(os.path.join(root, ".pclint_cache"))
+
+
+def test_cache_corrupt_file_is_a_cold_start(tmp_path):
+    from pycatkin_tpu.lint.cache import LintCache
+    root = _cache_tree(tmp_path)
+    cdir = tmp_path / ".pclint_cache"
+    cdir.mkdir()
+    (cdir / "cache.json").write_text("{definitely not json",
+                                     encoding="utf-8")
+    c = LintCache(root)
+    r = _cached_run(root, c)
+    assert len(active(r.findings)) == 1   # works, just uncached
+    c.save()                              # and repairs the file
+    from pycatkin_tpu.lint.cache import CACHE_VERSION
+    data = json.load(open(cdir / "cache.json", encoding="utf-8"))
+    assert data["version"] == CACHE_VERSION

@@ -1,0 +1,192 @@
+"""pcsan selftests: every tripwire must FIRE on an injected violation
+and stay SILENT on the sanctioned idiom.
+
+Each sanitizer guards a contract the suite already tests from the
+positive side (zero-compile rate, sync budget, non-blocking serve
+loop); these tests prove the negative side -- that when the contract
+breaks, the sanitizer actually raises, at the right seam, naming the
+culprit. `make test-san` re-runs the undisturbed suites under
+``PYCATKIN_SAN=1`` on top of this file.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from pycatkin_tpu import engine, san
+from pycatkin_tpu.lint.hotpath import MAX_CLEAN_SYNCS
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         sweep_steady_state)
+from pycatkin_tpu.san import (RecompileSanError, StallSanError,
+                              SyncSanError, recompile, stall, syncs)
+from pycatkin_tpu.utils import profiling
+
+pytestmark = pytest.mark.san
+
+
+def test_enabled_parses_env(monkeypatch):
+    monkeypatch.delenv(san.ENV, raising=False)
+    assert not san.enabled()
+    for v in ("1", "on", "true", "YES"):
+        monkeypatch.setenv(san.ENV, v)
+        assert san.enabled()
+    monkeypatch.setenv(san.ENV, "0")
+    assert not san.enabled()
+
+
+# ---------------------------------------------------- recompile sanitizer
+
+@pytest.fixture
+def recompile_armed():
+    """Activate the recompile sanitizer for one test, from cold, and
+    leave NOTHING armed afterwards (the state is process-global)."""
+    recompile.reset()
+    recompile.activate()
+    yield
+    recompile.deactivate()
+    recompile.reset()
+
+
+def test_note_compile_trips_only_when_warm(recompile_armed):
+    recompile.note_compile("unit compile")        # cold: recording phase
+    recompile.mark_warm()
+    with pytest.raises(RecompileSanError, match="fresh XLA compile"):
+        recompile.note_compile("unit compile")
+
+
+def test_recompile_sanitizer_trips_on_cold_key_after_warm(
+        recompile_armed):
+    """The injected violation of the zero-compile contract: warm the
+    cell at 8 lanes, then dispatch 16 -- a never-seen program key on a
+    warm cell. The error must name the operand that churned the key."""
+    sim = synthetic_system(n_species=8, n_reactions=10)
+    spec = sim.spec
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    conds8 = broadcast_conditions(sim.conditions(), 8)
+
+    sweep_steady_state(spec, conds8, tof_mask=mask)   # cold: records
+    recompile.mark_warm()
+    sweep_steady_state(spec, conds8, tof_mask=mask)   # warm replay: clean
+
+    conds16 = broadcast_conditions(sim.conditions(), 16)
+    with pytest.raises(RecompileSanError) as exc:
+        sweep_steady_state(spec, conds16, tof_mask=mask)
+    msg = str(exc.value)
+    assert "mark_warm()" in msg
+    # either seam is a correct catch: the dispatch key check names the
+    # churned operand, the compile site names the program label
+    assert ("churned the cache key" in msg
+            or "fresh XLA compile" in msg), msg
+
+
+def test_recompile_sanitizer_inactive_by_default():
+    assert not recompile.is_active() or san.enabled()
+
+
+# --------------------------------------------------------- sync sanitizer
+
+def test_sync_sanitizer_trips_on_uncounted_asarray():
+    import jax.numpy as jnp
+    dev = jnp.arange(8.0)
+    with syncs.strict(label="unit"):
+        with pytest.raises(SyncSanError, match=r"np\.asarray"):
+            np.asarray(dev)
+
+
+def test_sync_sanitizer_trips_on_device_get():
+    import jax
+    import jax.numpy as jnp
+    dev = jnp.arange(4.0)
+    with syncs.strict(label="unit"):
+        with pytest.raises(SyncSanError, match="device_get"):
+            jax.device_get(dev)
+
+
+def test_sync_sanitizer_ignores_host_values():
+    with syncs.strict(label="unit"):
+        assert np.asarray([1.0, 2.0]).shape == (2,)
+        assert np.array(3.5) == 3.5
+
+
+def test_sync_sanitizer_passive_outside_region():
+    import jax.numpy as jnp
+    syncs.install()
+    # no strict region: the patched seams forward untouched
+    assert np.asarray(jnp.arange(3.0)).shape == (3,)
+
+
+def test_counted_choke_point_passes_strict(monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv(san.ENV, "1")
+    profiling.reset_sync_count()
+    with syncs.strict(budget=2, label="unit") as region:
+        v = profiling.host_sync(jnp.arange(8.0), "unit pull")
+    assert isinstance(v, np.ndarray) and v.shape == (8,)
+    assert region["count"] == 1 and region["labels"] == ["unit pull"]
+    profiling.reset_sync_count()
+
+
+def test_sync_sanitizer_budget_trips_at_choke_point(monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv(san.ENV, "1")
+    profiling.reset_sync_count()
+    with syncs.strict(budget=2, label="unit"):
+        profiling.host_sync(jnp.arange(2.0), "first")
+        profiling.host_sync(jnp.arange(2.0), "second")
+        with pytest.raises(SyncSanError, match="budget of 2"):
+            profiling.host_sync(jnp.arange(2.0), "third")
+    profiling.reset_sync_count()
+
+
+def test_clean_sweep_passes_strict_region(monkeypatch):
+    """The positive contract under the runtime teeth: a warm clean
+    sweep runs inside a strict region at the documented budget without
+    tripping -- the same gate ``bench.py --smoke`` reports as
+    ``san_ok``."""
+    import jax.numpy as jnp                        # noqa: F401
+    monkeypatch.setenv(san.ENV, "1")
+    sim = synthetic_system(n_species=8, n_reactions=10)
+    spec = sim.spec
+    mask = engine.tof_mask_for(spec, [spec.rnames[-1]])
+    conds = broadcast_conditions(sim.conditions(), 8)
+    sweep_steady_state(spec, conds, tof_mask=mask)     # warm, unguarded
+    profiling.reset_sync_count()
+    with syncs.strict(budget=MAX_CLEAN_SYNCS, label="clean sweep"):
+        out = sweep_steady_state(spec, conds, tof_mask=mask)
+    assert bool(np.all(np.asarray(out["success"])))
+    profiling.reset_sync_count()
+
+
+# --------------------------------------------------- stall sanitizer
+
+def test_stall_threshold_env(monkeypatch):
+    monkeypatch.setenv(stall.STALL_ENV, "0.5")
+    assert stall.threshold_s() == 0.5
+    monkeypatch.setenv(stall.STALL_ENV, "bogus")
+    assert stall.threshold_s() == stall._DEFAULT_STALL_S
+
+
+def test_stall_sanitizer_trips_on_blocking_callback():
+    async def main():
+        await stall.arm(0.05)
+        loop = asyncio.get_running_loop()
+        loop.call_soon(time.sleep, 0.2)       # the injected stall
+        await asyncio.sleep(0.3)
+
+    with pytest.raises(StallSanError, match="held the serve loop"):
+        with stall.watchdog():
+            asyncio.run(main())
+
+
+def test_stall_sanitizer_clean_loop_passes():
+    async def main():
+        await stall.arm(0.05)
+        for _ in range(3):
+            await asyncio.sleep(0.01)
+
+    with stall.watchdog() as handler:
+        asyncio.run(main())
+    assert handler.stalls == []
