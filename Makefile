@@ -39,14 +39,23 @@
 # pack (prewarm must compile nothing), streams ~64 TCP requests, and
 # gates on a 100% post-warmup zero-compile rate, the p99 budget,
 # schema-complete responses (manifest/telemetry/quarantine present)
-# and a loss-free drain.
+# and a loss-free drain. `router-check` is the fleet-tier chaos drill
+# (docs/serving.md "Fleet serving"), run with the pcsan tripwires
+# armed: boot a 3-replica pack-warmed fleet behind the front router,
+# SIGKILL 2 of 3 replicas mid-soak (plus one torn line and one
+# connection reset at the dispatch sites), and hard-fail unless zero
+# requests are lost, every answer is bitwise identical to an
+# undisturbed same-grid run, the duplicate-suppression audit is clean,
+# and the restarted replicas serve from the AOT pack at a 100%
+# zero-compile rate.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test test-faults test-validate test-sharded test-san test-all \
 	lint lint-faults lint-syncs lint-baseline bench-smoke \
-	aot-pack-selftest obs-check perfwatch chaos serve-check
+	aot-pack-selftest obs-check perfwatch chaos serve-check \
+	router-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -109,3 +118,6 @@ chaos:
 
 serve-check:
 	env JAX_PLATFORMS=cpu python tools/soak.py --check
+
+router-check:
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python tools/soak.py --chaos

@@ -900,6 +900,29 @@ def smoke_main():
         serve = serve_rec.get("serve") or {}
         serve_ok = not serve_problems
 
+        # Router gate (ISSUE-16): a miniature fleet chaos drill --
+        # boot 2 subprocess replicas behind the front router, stream
+        # a small grid, SIGKILL one replica mid-stream -- gated on
+        # zero lost requests, bitwise identity against the
+        # undisturbed baseline and a clean duplicate audit (the
+        # pack-boot zero-compile proof runs in the full
+        # `make router-check` lane, not here). The router sub-object
+        # feeds the perfwatch history (router_availability /
+        # failover_p99_s).
+        from pycatkin_tpu.serve.soak import (check_chaos_record,
+                                             run_chaos_drill)
+        try:
+            router_rec = run_chaos_drill(
+                n_requests=8, bucket=16, lanes=2, mechs=2,
+                n_replicas=2, kill=1, max_occupancy=2,
+                with_pack=False)
+            router_problems = check_chaos_record(router_rec)
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            router_rec = {"router": {"error": str(e)}}
+            router_problems = [f"router chaos drill crashed: {e}"]
+        router = router_rec.get("router") or {}
+        router_ok = not router_problems
+
         # Sanitizer gate (ISSUE-14, pcsan): the same 8x8 sweep once
         # more with all three runtime tripwires armed -- recompile
         # (one recording pass, then mark_warm: a warm cell must
@@ -1082,6 +1105,8 @@ def smoke_main():
         "packed_ok": packed_ok,
         "serve": serve,
         "serve_ok": serve_ok,
+        "router": router,
+        "router_ok": router_ok,
         "san_ok": san_ok,
         "san_error": san_err,
         "lint_ok": True,
@@ -1149,6 +1174,10 @@ def smoke_main():
     if not serve_ok:
         log(f"bench-smoke: FAIL -- serve gate: "
             f"{'; '.join(serve_problems)}")
+        return 1
+    if not router_ok:
+        log(f"bench-smoke: FAIL -- router gate: "
+            f"{'; '.join(router_problems)}")
         return 1
     if not san_ok:
         log(f"bench-smoke: FAIL -- sanitizer gate (pcsan): {san_err}")

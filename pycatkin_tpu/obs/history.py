@@ -36,6 +36,10 @@ TRACKED_METRICS = {
     "serve_p99_s": "lower",         # tail request latency
     "serve_zero_compile_rate": "higher",  # post-warmup compile hygiene
     "serve_mean_occupancy": "higher",     # achieved pack occupancy
+    # Fleet-tier SLOs (chaos-drill records and bench smoke's router
+    # gate; pulled from the record's "router" sub-object).
+    "router_availability": "higher",  # answered-ok fraction under chaos
+    "failover_p99_s": "lower",        # tail failure-to-answer latency
 }
 
 # A regression must clear BOTH gates: beyond ``mad_k`` median absolute
@@ -75,10 +79,14 @@ def extract_metrics(record: dict) -> dict:
     one (possibly wrapped) bench record. ``mfu`` is pulled from the
     cost-ledger totals when the record carries one; ``serve_*``
     metrics fall back to the ``serve`` sub-object a serve-soak record
-    (or the smoke gate) nests them under."""
+    (or the smoke gate) nests them under; ``router_availability`` /
+    ``failover_p99_s`` likewise fall back to the ``router``
+    sub-object of a chaos-drill record."""
     rec = _unwrap(record)
     serve = rec.get("serve") if isinstance(rec.get("serve"),
                                            dict) else {}
+    router = rec.get("router") if isinstance(rec.get("router"),
+                                             dict) else {}
     out = {}
     for key in TRACKED_METRICS:
         v = rec.get(key)
@@ -87,6 +95,10 @@ def extract_metrics(record: dict) -> dict:
                  or {}).get("mfu")
         if v is None and key.startswith("serve_"):
             v = serve.get(key[len("serve_"):])
+        if v is None and key == "router_availability":
+            v = router.get("availability")
+        if v is None and key == "failover_p99_s":
+            v = router.get("failover_p99_s")
         try:
             f = float(v)
         except (TypeError, ValueError):

@@ -39,6 +39,15 @@ Faults:
 - ``slow-worker``     -- sleeps ``delay_s`` at a worker site: a
   straggler that makes progress, just slowly, for work-stealing and
   deadline drills.
+- ``replica-crash`` / ``replica-stall`` / ``conn-reset`` /
+  ``torn-line`` -- serve-tier chaos kinds (docs/serving.md). These are
+  *externally enacted*: the fault layer cannot SIGKILL a different
+  process or sever a socket it does not own, so the fleet supervisor
+  and front router poll :func:`take` at their ``router:replica:<i>`` /
+  ``router:dispatch:<i>`` sites and enact the fired spec themselves
+  (SIGKILL the replica subprocess, SIGSTOP it, abort the replica
+  connection, write a truncated JSON line). ``on_call`` never fires
+  them, so a plan mixing serve-tier and in-process kinds stays safe.
 
 Activation: pass a plan to :func:`fault_scope` (tests), or set the
 ``PYCATKIN_FAULTS`` environment variable to the JSON list of fault
@@ -70,7 +79,13 @@ from dataclasses import dataclass
 ENV_VAR = "PYCATKIN_FAULTS"
 
 _KINDS = ("transient", "permanent", "nan", "stall",
-          "worker-crash", "heartbeat-stall", "slow-worker")
+          "worker-crash", "heartbeat-stall", "slow-worker",
+          "replica-crash", "replica-stall", "conn-reset", "torn-line")
+
+# Kinds enacted by the serve tier itself (fleet supervisor / front
+# router) via take(), never by on_call.
+EXTERNAL_KINDS = ("replica-crash", "replica-stall", "conn-reset",
+                  "torn-line")
 
 
 class InjectedDeviceLossError(RuntimeError):
@@ -260,6 +275,28 @@ class FaultPlan:
                     f"occurrence={occ}")
         return occ
 
+    def take(self, site: str, kinds=EXTERNAL_KINDS) -> list:
+        """Consume due *externally-enacted* faults at ``site`` and
+        return the fired :class:`FaultSpec` list WITHOUT acting on
+        them: serve-tier kinds (replica-crash, conn-reset, ...) name
+        effects only their caller can produce -- killing a replica
+        subprocess, severing a routed connection -- so the caller
+        enacts what comes back. Advances the site's occurrence counter
+        and consumes ``times`` budgets (O_EXCL tickets under a
+        ``state_dir``) exactly like :meth:`on_call`."""
+        with self._lock:
+            occ = self._calls.get(site, 0)
+            self._calls[site] = occ + 1
+            fired = []
+            for i in self._due(site, occ, tuple(kinds)):
+                spec = self.specs[i]
+                if not self._acquire(i, spec):
+                    continue
+                self.log.append({"site": site, "occurrence": occ,
+                                 "kind": spec.kind})
+                fired.append(spec)
+        return fired
+
     def on_result(self, site: str, out):
         """Injection hook AFTER a successful dispatch at ``site``:
         applies any due 'nan' poisoning to the result."""
@@ -313,6 +350,15 @@ def inject(site: str) -> None:
     plan = active_plan()
     if plan is not None:
         plan.on_call(site)
+
+
+def take(site: str, kinds=EXTERNAL_KINDS) -> list:
+    """Module-level externally-enacted-fault hook (see
+    :meth:`FaultPlan.take`): no-op empty list without an active plan."""
+    plan = active_plan()
+    if plan is None:
+        return []
+    return plan.take(site, kinds)
 
 
 def transform(site: str, out):

@@ -13,13 +13,22 @@ admits mechanism + conditions-grid requests, coalesces same-bucket
 tenants into packed dispatches with SLA-aware flushing, and answers
 every request with its run manifest, per-lane telemetry and quarantine
 report. Schema and semantics: docs/serving.md.
+
+Above the single server sits the fleet tier (PR 16): a
+:class:`ReplicaSupervisor` (serve/fleet.py) keeping N pack-warmed
+server replicas alive, and a :class:`SweepRouter` (serve/router.py)
+multiplexing clients across them with circuit breakers, SLA-budgeted
+retries, hedged interactive dispatch and loss-free failover.
 """
 
 from .client import SweepClient, TcpSweepClient
+from .fleet import FleetConfig, ReplicaSupervisor
 from .protocol import (DEADLINE_CLASSES, ServeConfig, ServeError,
                        error_response)
+from .router import RouterConfig, SweepRouter
 from .server import SweepServer
 
 __all__ = ["SweepServer", "SweepClient", "TcpSweepClient",
            "ServeConfig", "ServeError", "DEADLINE_CLASSES",
-           "error_response"]
+           "error_response", "ReplicaSupervisor", "FleetConfig",
+           "SweepRouter", "RouterConfig"]

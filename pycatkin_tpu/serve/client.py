@@ -16,7 +16,17 @@ import itertools
 import json
 from typing import Optional
 
-from .protocol import E_INTERNAL, error_response
+from .protocol import (E_INTERNAL, E_TIMEOUT, error_response,
+                       request_timeout_for)
+
+# A connection delivering this many CONSECUTIVE undecodable lines is
+# torn, not merely glitched: fail every pending request fast instead of
+# letting callers sit on futures that will never resolve. One bad line
+# (a torn final write from a dying server) only bumps the metric; the
+# streak resets on the next good line.
+TORN_LINE_LIMIT = 8
+
+_UNSET = object()
 
 
 def sweep_payload(mechanism, T, p=1.0e5, tof_terms=None,
@@ -76,6 +86,7 @@ class TcpSweepClient:
         self._seq = itertools.count()
         self._read_task = None
         self._wlock = asyncio.Lock()
+        self.torn_lines = 0
 
     async def connect(self) -> "TcpSweepClient":
         self._reader, self._writer = await asyncio.open_connection(
@@ -85,6 +96,12 @@ class TcpSweepClient:
         return self
 
     async def _read_loop(self):
+        from ..obs import metrics
+        torn = metrics.counter(
+            "pycatkin_serve_torn_lines_total",
+            "undecodable JSON lines received by serve TCP clients")
+        why = "connection closed"
+        streak = 0
         try:
             while True:
                 line = await self._reader.readline()
@@ -93,31 +110,68 @@ class TcpSweepClient:
                 try:
                     resp = json.loads(line)
                 except ValueError:
+                    # A torn line (partial write from a dying peer) is
+                    # accounted, never silently dropped; the sender
+                    # retries by id, so the lost response is recovered
+                    # upstream.
+                    self.torn_lines += 1
+                    torn.inc()
+                    streak += 1
+                    if streak >= TORN_LINE_LIMIT:
+                        why = (f"{streak} consecutive undecodable "
+                               f"lines: stream torn")
+                        break
                     continue
+                streak = 0
                 fut = self._pending.pop(resp.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result(resp)
+        except (ConnectionError, OSError,
+                asyncio.IncompleteReadError) as exc:
+            why = f"connection lost: {exc}"
         finally:
             # Connection gone: fail whatever is still waiting rather
             # than hanging the caller forever.
-            err = error_response(None, E_INTERNAL, "connection closed")
+            err = error_response(None, E_INTERNAL, why)
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_result(dict(err))
             self._pending.clear()
 
-    async def request(self, payload: dict) -> dict:
+    async def request(self, payload: dict, timeout=_UNSET) -> dict:
         """Send one request object; resolves when ITS response (by
-        ``id``) arrives, regardless of interleaving."""
+        ``id``) arrives, regardless of interleaving.
+
+        Every request carries a deadline: ``timeout`` defaults to the
+        payload's deadline-class request timeout
+        (:func:`protocol.request_timeout_for`), so a stalled -- not
+        closed -- server resolves to a structured ``E_TIMEOUT`` error
+        instead of hanging the caller forever. Pass ``timeout=None``
+        to wait indefinitely, or a float to override."""
         if payload.get("id") is None:
             payload = dict(payload, id=f"t{next(self._seq)}")
+        if timeout is _UNSET:
+            timeout = request_timeout_for(
+                payload.get("deadline_class", "standard"))
+        req_id = payload["id"]
         fut = asyncio.get_running_loop().create_future()
-        self._pending[payload["id"]] = fut
+        self._pending[req_id] = fut
         data = (json.dumps(payload) + "\n").encode()
         async with self._wlock:
             self._writer.write(data)
             await self._writer.drain()
-        return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            if fut.done():         # answer raced the deadline: keep it
+                return fut.result()  # pclint: disable=PCL010 -- asyncio future already done; returns instantly
+            fut.cancel()
+            return error_response(
+                req_id, E_TIMEOUT,
+                f"no response within {timeout:.3f} s "
+                f"(deadline_class "
+                f"{payload.get('deadline_class', 'standard')!r})")
 
     async def sweep(self, mechanism, T, p=1.0e5, **kwargs) -> dict:
         return await self.request(

@@ -29,17 +29,34 @@ BUDGET_INTERACTIVE_ENV = "PYCATKIN_SERVE_BUDGET_INTERACTIVE"
 BUDGET_STANDARD_ENV = "PYCATKIN_SERVE_BUDGET_STANDARD"
 BUDGET_BATCH_ENV = "PYCATKIN_SERVE_BUDGET_BATCH"
 
+TIMEOUT_INTERACTIVE_ENV = "PYCATKIN_SERVE_TIMEOUT_INTERACTIVE"
+TIMEOUT_STANDARD_ENV = "PYCATKIN_SERVE_TIMEOUT_STANDARD"
+TIMEOUT_BATCH_ENV = "PYCATKIN_SERVE_TIMEOUT_BATCH"
+
 _DEFAULT_BUDGETS = {"interactive": 0.02, "standard": 0.2, "batch": 2.0}
 _BUDGET_ENVS = {"interactive": BUDGET_INTERACTIVE_ENV,
                 "standard": BUDGET_STANDARD_ENV,
                 "batch": BUDGET_BATCH_ENV}
 DEADLINE_CLASSES = tuple(_DEFAULT_BUDGETS)
 
+# Per-class END-TO-END request deadlines (seconds from send to
+# response), distinct from the coalescing WAIT budgets above: the wait
+# budget bounds how long a request may sit collecting co-tenants; the
+# request timeout bounds the whole round trip, solve included, and is
+# what the TCP client and the front router resolve to a structured
+# ``E_TIMEOUT`` instead of hanging on a stalled peer.
+_DEFAULT_TIMEOUTS = {"interactive": 30.0, "standard": 120.0,
+                     "batch": 600.0}
+_TIMEOUT_ENVS = {"interactive": TIMEOUT_INTERACTIVE_ENV,
+                 "standard": TIMEOUT_STANDARD_ENV,
+                 "batch": TIMEOUT_BATCH_ENV}
+
 # Structured reject/error codes (docs/serving.md).
 E_BAD_REQUEST = "bad_request"
 E_OVERLOADED = "overloaded"
 E_DRAINING = "draining"
 E_INTERNAL = "internal"
+E_TIMEOUT = "timeout"
 
 
 class ServeError(Exception):
@@ -103,6 +120,23 @@ class ServeConfig:
                 E_BAD_REQUEST,
                 f"unknown deadline_class {deadline_class!r}; choose "
                 f"one of {sorted(self.budgets)}") from None
+
+
+def request_timeouts() -> dict:
+    """Per-class end-to-end request deadlines in seconds,
+    env-overridable per class (``PYCATKIN_SERVE_TIMEOUT_*``)."""
+    out = {}
+    for cls, default in _DEFAULT_TIMEOUTS.items():
+        out[cls] = float(os.environ.get(_TIMEOUT_ENVS[cls], default))
+    return out
+
+
+def request_timeout_for(deadline_class: str) -> float:
+    """The end-to-end deadline of one request of this class; unknown
+    classes get the ``standard`` deadline (the request itself is
+    validated -- and rejected -- elsewhere)."""
+    return request_timeouts().get(str(deadline_class),
+                                  _DEFAULT_TIMEOUTS["standard"])
 
 
 def jsonable(obj):

@@ -280,6 +280,333 @@ def run_soak(out_path: Optional[str] = None, **kwargs) -> dict:
     return record
 
 
+CHAOS_SCHEMA = "pycatkin-serve-chaos/v1"
+
+
+async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
+                            lanes: int = 3, mechs: int = 4,
+                            n_replicas: int = 3, kill: int = 2,
+                            max_occupancy: int = 4, seed: int = 0,
+                            with_pack: bool = True,
+                            work_dir: Optional[str] = None,
+                            verbose: bool = False) -> dict:
+    """The serve-tier chaos drill (docs/failure_model.md):
+
+    1. **baseline** -- every request of the drill grid is answered by
+       an UNDISTURBED in-process server; the canonical answers are the
+       bitwise-identity reference. The run warms the AOT cache, which
+       (``with_pack``) is exported as the replicas' boot pack.
+    2. **fleet** -- ``n_replicas`` pack-booted replicas under a
+       :class:`fleet.ReplicaSupervisor`, fronted by a
+       :class:`router.SweepRouter`; the same grid streams through the
+       router over TCP (every 4th request ``interactive``, so hedged
+       dispatch runs too).
+    3. **chaos** -- once a third of the stream has completed, a fault
+       plan (O_EXCL ticket budgets under ``work_dir``) SIGKILLs
+       ``kill`` of the replicas via their ``router:replica:<i>`` sites
+       and tears one dispatch line + resets one connection at
+       ``router:dispatch:<i>``. In-flight requests must fail over.
+    4. **audit** -- zero lost requests, every answer bitwise identical
+       to the baseline, the router's duplicate-suppression audit has
+       zero mismatches, killed replicas restarted (incarnation >= 2)
+       and -- ``with_pack`` -- every replica's flushes compiled
+       NOTHING (the pack-boot zero-compile proof), re-verified with
+       one direct sweep per restarted replica.
+    """
+    import sys
+    import tempfile
+
+    from ..models.synthetic import synthetic_system_for_bucket
+    from ..robustness import faults
+    from ..utils.io import system_to_dict
+    from .client import SweepClient, TcpSweepClient, sweep_payload
+    from .fleet import FleetConfig, ReplicaSupervisor
+    from .protocol import ServeConfig
+    from .router import SweepRouter, _canonical
+    from .server import SweepServer
+
+    rng = np.random.default_rng(seed)
+    t_wall0 = time.monotonic()
+
+    def say(msg):
+        if verbose:
+            print(f"chaos-drill: {msg}", flush=True)
+
+    sims = [synthetic_system_for_bucket(
+                bucket, seed=int(rng.integers(0, 2**31)))
+            for _ in range(mechs)]
+    mech_dicts = [system_to_dict(s) for s in sims]
+    plan_grid = [(i % mechs,
+                  [float(t) for t in rng.uniform(480.0, 520.0,
+                                                 size=lanes)],
+                  "interactive" if i % 4 == 0 else "standard")
+                 for i in range(n_requests)]
+
+    own_td = None
+    if work_dir is None:
+        own_td = tempfile.TemporaryDirectory(prefix="pycatkin_chaos_")
+        work_dir = own_td.name
+    pack_path = os.path.join(work_dir, "chaos_pack.tar.gz")
+    tickets = os.path.join(work_dir, "fault_tickets")
+    supervisor = router = client = None
+    drill_ok = False
+    try:
+        # -- phase 1: undisturbed baseline + pack ----------------------
+        say(f"baseline: {n_requests} requests, in-process")
+        base_cfg = ServeConfig(port=0, max_occupancy=max_occupancy)
+        base = await SweepServer(base_cfg).start(listen=False)
+        k_buckets = sorted({1 << i for i in range(
+            max(1, max_occupancy).bit_length())} | {max_occupancy})
+        await asyncio.to_thread(base.warm, sims, lanes,
+                                tuple(k for k in k_buckets if k > 1))
+        bclient = SweepClient(base)
+        sem = asyncio.Semaphore(8)
+
+        async def base_one(i):
+            mi, T, cls = plan_grid[i]
+            async with sem:
+                return await bclient.sweep(mech_dicts[mi], T,
+                                           deadline_class=cls)
+        base_resps = await asyncio.gather(
+            *(base_one(i) for i in range(n_requests)))
+        bad = [r for r in base_resps if not r.get("ok")]
+        if bad:
+            raise RuntimeError(f"baseline run failed: {bad[:3]}")
+        baseline = [_canonical(r) for r in base_resps]
+        backend = ((base.boot_manifest.get("backend") or {})
+                   .get("platform")) or "cpu"
+        await base.drain()
+        if with_pack:
+            from ..parallel.compile_pool import export_cache_pack
+            stats = await asyncio.to_thread(export_cache_pack,
+                                            pack_path)
+            say(f"exported boot pack ({stats['entries']} entries)")
+
+        # -- phase 2: fleet + router -----------------------------------
+        replica_cache = os.path.join(work_dir, "replica_cache")
+        env = {"PYCATKIN_ABI": "1"}
+        if with_pack:
+            env["PYCATKIN_AOT_CACHE"] = replica_cache
+        cmd = [sys.executable, "-m", "pycatkin_tpu.serve",
+               "--host", "127.0.0.1", "--port", "0",
+               "--max-occupancy", str(max_occupancy)]
+        supervisor = ReplicaSupervisor(FleetConfig(
+            n_replicas=n_replicas, command=cmd, env=env,
+            aot_pack=pack_path if with_pack else None))
+        say(f"booting {n_replicas} replicas"
+            f"{' from pack' if with_pack else ''}")
+        await supervisor.start()
+        router = await SweepRouter(supervisor).start()
+        client = await TcpSweepClient("127.0.0.1",
+                                      router.port).connect()
+
+        # -- phase 3: stream + mid-soak chaos --------------------------
+        results: list = [None] * n_requests
+        done_box = {"n": 0}
+
+        async def fleet_one(i):
+            mi, T, cls = plan_grid[i]
+            async with sem:
+                resp = await client.request(sweep_payload(
+                    mech_dicts[mi], T, deadline_class=cls,
+                    req_id=f"q{i}"))
+            results[i] = resp
+            done_box["n"] += 1
+
+        say(f"streaming {n_requests} requests through the router")
+        drive = asyncio.ensure_future(asyncio.gather(
+            *(fleet_one(i) for i in range(n_requests))))
+        trigger = max(1, n_requests // 3)
+        while done_box["n"] < trigger and not drive.done():
+            await asyncio.sleep(0.01)
+        specs = [{"site": f"router:replica:{i}",
+                  "kind": "replica-crash", "times": 1}
+                 for i in range(kill)]
+        specs += [{"site": "router:dispatch:*", "kind": "conn-reset",
+                   "times": 1},
+                  {"site": "router:dispatch:*", "kind": "torn-line",
+                   "times": 1}]
+        chaos = faults.FaultPlan(specs, state_dir=tickets)
+        say(f"chaos: SIGKILLing {kill} of {n_replicas} replicas "
+            f"mid-soak")
+        with faults.fault_scope(chaos):
+            await drive
+        kills_fired = [e for e in chaos.log
+                       if e["kind"] == "replica-crash"]
+
+        # -- phase 4: audit --------------------------------------------
+        say("waiting for killed replicas to reboot from the pack")
+        reboot_deadline = time.monotonic() + 120.0
+        killed = [supervisor.replicas[i] for i in range(kill)]
+        while time.monotonic() < reboot_deadline and any(
+                r.state != "abandoned"
+                and (r.incarnation < 2 or not r.routable)
+                for r in killed):
+            await asyncio.sleep(0.1)
+
+        n_ok = sum(1 for r in results if r and r.get("ok"))
+        mismatches = [i for i, r in enumerate(results)
+                      if r and r.get("ok")
+                      and _canonical(r) != baseline[i]]
+        replica_stats = {}
+        reverify_bad = []
+        for r in supervisor.replicas:
+            if not r.routable:
+                continue
+            rc = await TcpSweepClient("127.0.0.1",
+                                      r.port).connect()
+            try:
+                if r.incarnation > 1:
+                    # One direct sweep through the REBOOTED replica:
+                    # its answer must match the baseline bit for bit.
+                    mi, T, cls = plan_grid[0]
+                    resp = await rc.request(sweep_payload(
+                        mech_dicts[mi], T, deadline_class=cls,
+                        req_id=f"verify{r.idx}"))
+                    if not resp.get("ok") or \
+                            _canonical(resp) != baseline[0]:
+                        reverify_bad.append(r.idx)
+                st = await rc.stats()
+                replica_stats[str(r.idx)] = (st.get("stats")
+                                             if st.get("ok") else None)
+            finally:
+                await rc.close()
+        zero_compile_bad = []
+        if with_pack:
+            for idx, st in replica_stats.items():
+                if not st or not st.get("flushes") \
+                        or st.get("flushes_with_compiles"):
+                    zero_compile_bad.append(
+                        {"replica": idx,
+                         "flushes": st.get("flushes") if st else None,
+                         "flushes_with_compiles":
+                             st.get("flushes_with_compiles")
+                             if st else None})
+        rstats = router.stats()
+        await client.close()
+        await router.drain()
+        await supervisor.stop()
+        drill_ok = True
+    finally:
+        if not drill_ok:
+            # Best-effort teardown on the failure path so a raising
+            # drill never strands replica subprocesses.
+            for closer in (client and client.close,
+                           router and router.stop,
+                           supervisor and supervisor.stop):
+                if closer is None:
+                    continue
+                try:
+                    await closer()
+                except Exception:
+                    pass
+        if own_td is not None:
+            own_td.cleanup()
+
+    incarnations = [r.incarnation for r in supervisor.replicas]
+    record = {
+        "bench": "serve-chaos-drill", "schema": CHAOS_SCHEMA,
+        "backend": backend, "with_pack": bool(with_pack),
+        "n_requests": n_requests, "n_ok": n_ok,
+        "n_failed": n_requests - n_ok,
+        "bucket": bucket, "lanes": lanes, "mechs": mechs,
+        "max_occupancy": max_occupancy, "seed": seed,
+        "n_replicas": n_replicas, "kill": kill,
+        "kills_fired": len(kills_fired),
+        "chaos_log": chaos.log,
+        "incarnations": incarnations,
+        "router": {
+            "availability": rstats.get("availability"),
+            "failover_p99_s": rstats.get("failover_p99_s"),
+            "retries": rstats.get("retries"),
+            "hedges": rstats.get("hedges"),
+            "failovers": rstats.get("failovers"),
+            "duplicates": rstats.get("duplicates"),
+            "lost": n_requests - n_ok,
+            "bitwise_mismatches": len(mismatches),
+            "reverify_failed": reverify_bad,
+            "zero_compile_violations": zero_compile_bad,
+        },
+        "router_stats": rstats,
+        "replica_stats": replica_stats,
+        "failures": [r.get("error") for r in results
+                     if r and not r.get("ok")][:10],
+        "wall_s": time.monotonic() - t_wall0,
+    }
+    return record
+
+
+def run_chaos_drill(out_path: Optional[str] = None, **kwargs) -> dict:
+    """Synchronous entry for the chaos drill (forces the ABI gate on,
+    like :func:`run_soak`); optionally writes the record.
+
+    Unless the caller pinned them, the per-class request timeouts are
+    widened to the standard budget for the drill's duration: on the
+    CPU CI backend a flush takes seconds and a killed replica's
+    pack-warmed reboot tens of seconds, so the production
+    ``interactive`` SLA would turn backend slowness into fake request
+    loss -- the drill gates on LOSS under chaos, not on CPU latency.
+    """
+    from .protocol import _TIMEOUT_ENVS, request_timeout_for
+    saved = {}
+    for var in ("PYCATKIN_ABI", *(_TIMEOUT_ENVS.values())):
+        saved[var] = os.environ.get(var)
+    os.environ["PYCATKIN_ABI"] = "1"
+    for cls, var in _TIMEOUT_ENVS.items():
+        if saved[var] is None:
+            os.environ[var] = str(request_timeout_for("standard"))
+    try:
+        record = asyncio.run(chaos_drill_async(**kwargs))
+    finally:
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
+def check_chaos_record(record: dict) -> list:
+    """Gate a chaos-drill record; returns failure strings (empty =
+    pass). ``make router-check`` and ``bench.py --smoke`` share it."""
+    problems = []
+    router = record.get("router") or {}
+    if router.get("lost"):
+        problems.append(f"{router['lost']} of "
+                        f"{record.get('n_requests')} requests lost "
+                        f"during the drill: {record.get('failures')}")
+    if router.get("bitwise_mismatches"):
+        problems.append(f"{router['bitwise_mismatches']} answers "
+                        f"differ bitwise from the undisturbed "
+                        f"baseline run")
+    dups = router.get("duplicates") or {}
+    if dups.get("mismatched"):
+        problems.append(f"duplicate-suppression audit: "
+                        f"{dups['mismatched']} suppressed answers "
+                        f"were NOT bit-identical to the delivered one")
+    if record.get("kills_fired", 0) < record.get("kill", 0):
+        problems.append(f"chaos plan fired only "
+                        f"{record.get('kills_fired')} of "
+                        f"{record.get('kill')} replica kills")
+    incs = record.get("incarnations") or []
+    restarted = sum(1 for i in incs[:record.get("kill", 0)] if i >= 2)
+    if restarted < record.get("kill", 0):
+        problems.append(f"only {restarted} of {record.get('kill')} "
+                        f"killed replicas came back "
+                        f"(incarnations={incs})")
+    if router.get("reverify_failed"):
+        problems.append(f"rebooted replicas "
+                        f"{router['reverify_failed']} answered the "
+                        f"verification sweep wrong")
+    if record.get("with_pack") and router.get("zero_compile_violations"):
+        problems.append(f"pack-booted replicas compiled during "
+                        f"flushes: {router['zero_compile_violations']}")
+    return problems
+
+
 def check_soak_record(record: dict, p99_budget_s: float = 30.0,
                       expect_zero_compiles: bool = True,
                       expect_warm_compiled_zero: bool = False) -> list:

@@ -32,6 +32,7 @@ test suite exercises each branch of this module deterministically.
 
 from __future__ import annotations
 
+import asyncio
 import random
 import signal
 import sys
@@ -62,6 +63,26 @@ TRANSIENT_MARKERS = (
 TRANSIENT_GRPC_CODES = frozenset(
     {"UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED"})
 
+# Exception TYPES that are transient by construction: asyncio/socket
+# transport failures at the serve tier. A reset/refused/half-read
+# connection and a burned deadline are preemption-shaped -- the peer
+# (or the route to it) went away, not the program -- so the front
+# router's failover and the TCP clients' retries treat them exactly
+# like a transient backend error (same-width sweeps are deterministic,
+# so a duplicated dispatch is bit-identical and therefore safe).
+# asyncio.TimeoutError is TimeoutError on 3.11+, but keep both spelled
+# out for older interpreters; IncompleteReadError is the stream-reader
+# face of a torn connection.
+TRANSIENT_CONNECTION_TYPES = (
+    ConnectionResetError,
+    ConnectionRefusedError,
+    ConnectionAbortedError,
+    BrokenPipeError,
+    asyncio.IncompleteReadError,
+    asyncio.TimeoutError,
+    TimeoutError,
+)
+
 # Stop printing per-retry lines after this many within one call; a
 # single summary line marks the suppression.
 _LOG_CAP = 3
@@ -89,13 +110,18 @@ def is_transient_backend_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a transport/compile-service flake
     rather than a program error.
 
-    Two classes qualify: ``jax.errors.JaxRuntimeError`` whose text
-    carries a :data:`TRANSIENT_MARKERS` signature, and raw gRPC-style
-    exceptions (``grpc.RpcError`` or anything exposing ``code()``)
-    whose status is in :data:`TRANSIENT_GRPC_CODES`. Arbitrary Python
-    exceptions that merely CONTAIN a marker string (e.g.
+    Three classes qualify: asyncio/socket transport failures by TYPE
+    (:data:`TRANSIENT_CONNECTION_TYPES` -- the taxonomy the front
+    router's failover and the serve clients share with this wrapper),
+    ``jax.errors.JaxRuntimeError`` whose text carries a
+    :data:`TRANSIENT_MARKERS` signature, and raw gRPC-style exceptions
+    (``grpc.RpcError`` or anything exposing ``code()``) whose status
+    is in :data:`TRANSIENT_GRPC_CODES`. Arbitrary Python exceptions
+    that merely CONTAIN a marker string (e.g.
     ``ValueError("remote_compile")``) stay non-transient -- a program
     error must never be silently re-run."""
+    if isinstance(exc, TRANSIENT_CONNECTION_TYPES):
+        return True
     status = _grpc_status_name(exc)
     if status is not None:
         return status.upper() in TRANSIENT_GRPC_CODES

@@ -1,0 +1,729 @@
+"""The fleet tier (docs/serving.md "Fleet serving"): replica
+supervision, the front router, and the failure taxonomy they share.
+
+No JAX and no real SweepServer anywhere in this module: the router is
+deliberately bytes-only, so it is tested against stub TCP replicas
+speaking the wire protocol, and the supervisor against a tiny
+subprocess stub (``FleetConfig.command``) that boots in milliseconds.
+The acceptance surface, smallest-first: the retry taxonomy classifies
+asyncio/socket failures; the TCP client turns a stalled server into a
+structured ``E_TIMEOUT`` and a torn stream into a fast failure; the
+supervisor registers pack-order boots, classifies exits, restarts on
+backoff, demotes stalled replicas and abandons crash loops; the router
+fails over losslessly, opens/probes/closes breakers, hedges
+interactive requests with a bitwise duplicate audit, enacts the
+connection-level chaos kinds, and answers every accepted request even
+when drain races a replica death.
+"""
+
+import asyncio
+import json
+import signal
+import sys
+import textwrap
+
+import pytest
+
+from pycatkin_tpu.robustness import faults
+from pycatkin_tpu.serve import client as serve_client
+from pycatkin_tpu.serve.client import TcpSweepClient, sweep_payload
+from pycatkin_tpu.serve.fleet import FleetConfig, ReplicaSupervisor
+from pycatkin_tpu.serve.protocol import (E_DRAINING, E_INTERNAL,
+                                         E_OVERLOADED, E_TIMEOUT,
+                                         request_timeout_for)
+from pycatkin_tpu.serve.router import (CircuitBreaker, RouterConfig,
+                                       SweepRouter, _canonical)
+from pycatkin_tpu.utils import retry
+
+pytestmark = pytest.mark.faults
+
+
+# -- stub replicas + fake supervisor -----------------------------------
+
+
+class StubReplica:
+    """A wire-compatible replica: answers ``ping`` natively and routes
+    ``sweep`` through a swappable ``behavior(payload, writer)``
+    coroutine returning the response dict (or None to stay silent)."""
+
+    def __init__(self, behavior=None, answer_ping=True):
+        self.behavior = behavior or answer_sweep
+        self.answer_ping = answer_ping
+        self.up = True          # FakeSupervisor routability flag
+        self.port = None
+        self.sweeps_seen = 0
+        self.bad_lines = 0
+        self._server = None
+        self._tasks = set()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self._server.close()
+        await self._server.wait_closed()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+
+    async def _handle_sweep(self, payload, writer):
+        # Concurrent per-request handling, like the real SweepServer:
+        # the protocol is id-multiplexed, so responses may interleave
+        # and come back out of order.
+        try:
+            resp = await self.behavior(payload, writer)
+            if resp is not None:
+                await _write(writer, resp)
+        except (ConnectionError, OSError):
+            pass
+
+    async def _on_conn(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    self.bad_lines += 1
+                    continue
+                if payload.get("op") == "ping":
+                    if self.answer_ping:
+                        await _write(writer, {
+                            "ok": True, "pong": True,
+                            "id": payload.get("id")})
+                    continue
+                self.sweeps_seen += 1
+                task = asyncio.ensure_future(
+                    self._handle_sweep(payload, writer))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except (ConnectionError, OSError):
+                pass
+
+
+async def _write(writer, obj):
+    writer.write((json.dumps(obj) + "\n").encode())
+    await writer.drain()
+
+
+async def answer_sweep(payload, writer):
+    """Deterministic answer derived from the request: two replicas
+    given the same sweep produce bit-identical responses, which is the
+    property the duplicate audit leans on."""
+    return {"ok": True, "id": payload["id"],
+            "result": {"echo": payload.get("conditions")},
+            "quarantine": {"n_quarantined": 0}, "lanes": None}
+
+
+async def drop_connection(payload, writer):
+    writer.close()
+    return None
+
+
+async def stay_silent(payload, writer):
+    return None
+
+
+class FakeSupervisor:
+    """The supervisor surface the router consumes: ``endpoints()``,
+    ``stats()`` and routability-change listeners."""
+
+    def __init__(self, replicas):
+        self.replicas = list(replicas)
+        self._listeners = []
+
+    def add_listener(self, fn):
+        self._listeners.append(fn)
+
+    def endpoints(self):
+        return [{"idx": i, "incarnation": 1, "host": "127.0.0.1",
+                 "port": s.port}
+                for i, s in enumerate(self.replicas)
+                if s.up and s.port is not None]
+
+    def stats(self):
+        return {"n_replicas": len(self.replicas),
+                "up": sum(s.up for s in self.replicas), "replicas": []}
+
+    def notify(self, event, idx):
+        for fn in list(self._listeners):
+            fn({"event": event, "idx": idx, "incarnation": 1,
+                "host": "127.0.0.1",
+                "port": self.replicas[idx].port})
+
+
+def fast_router_config(**overrides):
+    kw = dict(max_inflight=16, breaker_fails=2,
+              breaker_cooldown_s=0.05, hedge_quantile=0.95,
+              hedge_min_s=0.02, retries=3, retry_base_delay_s=0.001,
+              retry_max_delay_s=0.01, connect_timeout_s=1.0,
+              probe_timeout_s=1.0, tick_s=0.005)
+    kw.update(overrides)
+    return RouterConfig(**kw)
+
+
+@pytest.fixture
+def short_budgets(monkeypatch):
+    """Small per-class SLA budgets so retry exhaustion is fast."""
+    monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_STANDARD", "2.0")
+    monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_INTERACTIVE", "1.5")
+
+
+async def _router_over(replicas, **cfg_overrides):
+    for r in replicas:
+        await r.start()
+    sup = FakeSupervisor(replicas)
+    router = await SweepRouter(
+        sup, fast_router_config(**cfg_overrides)).start(listen=False)
+    return sup, router
+
+
+async def _teardown(router, replicas):
+    await router.stop()
+    for r in replicas:
+        await r.stop()
+
+
+def _sweep(i=0, deadline_class="standard"):
+    return sweep_payload({"mech": "stub"}, [500.0 + i],
+                         deadline_class=deadline_class,
+                         req_id=f"r{i}")
+
+
+# -- retry taxonomy (utils/retry.py) -----------------------------------
+
+
+@pytest.mark.parametrize("exc", [
+    ConnectionResetError("peer reset"),
+    ConnectionRefusedError("nobody listening"),
+    ConnectionAbortedError("aborted"),
+    BrokenPipeError("write to dead peer"),
+    asyncio.IncompleteReadError(b"partial", 64),
+    asyncio.TimeoutError(),
+    TimeoutError("deadline burned"),
+])
+def test_connection_failures_are_transient_by_type(exc):
+    assert retry.is_transient_backend_error(exc)
+
+
+@pytest.mark.parametrize("exc", [
+    ValueError("connection reset"),      # marker text is NOT enough
+    KeyError("port"),
+    RuntimeError("shape mismatch"),
+])
+def test_program_errors_stay_non_transient(exc):
+    assert not retry.is_transient_backend_error(exc)
+
+
+def test_classify_worker_exit_taxonomy():
+    ok = retry.classify_worker_exit(0)
+    assert (ok.kind, ok.transient) == ("ok", False)
+    sig = retry.classify_worker_exit(-signal.SIGKILL)
+    assert (sig.kind, sig.transient) == ("signal-death", True)
+    assert "SIGKILL" in sig.detail
+    bad = retry.classify_worker_exit(3)
+    assert (bad.kind, bad.transient) == ("nonzero-exit", False)
+    to = retry.classify_worker_exit(None, timed_out=True)
+    assert (to.kind, to.transient) == ("timeout", True)
+    assert retry.classify_worker_exit(None).kind == "ok"
+
+
+def test_request_timeouts_come_from_the_deadline_class(monkeypatch):
+    monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_BATCH", "7.5")
+    assert request_timeout_for("batch") == 7.5
+    assert request_timeout_for("interactive") == 30.0
+    # Unknown classes fall back to the standard budget rather than
+    # hanging forever or crashing the wire loop.
+    assert request_timeout_for("nonsense") == \
+        request_timeout_for("standard")
+
+
+# -- TCP client deadlines + torn lines ---------------------------------
+
+
+def test_client_timeout_is_structured(monkeypatch):
+    async def scenario():
+        stub = await StubReplica(behavior=stay_silent).start()
+        cli = await TcpSweepClient("127.0.0.1", stub.port).connect()
+        try:
+            resp = await cli.request(_sweep(0), timeout=0.1)
+            assert resp["ok"] is False
+            assert resp["error"]["code"] == E_TIMEOUT
+            assert resp["id"] == "r0"
+            # The per-class default budget applies when no explicit
+            # timeout is passed.
+            monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_INTERACTIVE",
+                               "0.05")
+            resp = await cli.request(
+                _sweep(1, deadline_class="interactive"))
+            assert resp["error"]["code"] == E_TIMEOUT
+            assert "interactive" in resp["error"]["message"]
+        finally:
+            await cli.close()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+def test_client_counts_torn_final_line(monkeypatch):
+    async def behavior(payload, writer):
+        writer.write(b'{"id": "r0", "ok": true, "resu\n')  # torn
+        await writer.drain()
+        writer.close()
+        return None
+
+    async def scenario():
+        stub = await StubReplica(behavior=behavior).start()
+        cli = await TcpSweepClient("127.0.0.1", stub.port).connect()
+        try:
+            resp = await cli.request(_sweep(0), timeout=5.0)
+            # The torn line is counted and the dropped connection
+            # fails the pending request instead of hanging it.
+            assert cli.torn_lines == 1
+            assert resp["ok"] is False
+            assert resp["error"]["code"] == E_INTERNAL
+        finally:
+            await cli.close()
+            await stub.stop()
+        from pycatkin_tpu.obs import metrics
+        assert "pycatkin_serve_torn_lines_total" in \
+            metrics.snapshot()["counters"]
+    asyncio.run(scenario())
+
+
+def test_client_fails_fast_after_torn_streak():
+    async def behavior(payload, writer):
+        for _ in range(serve_client.TORN_LINE_LIMIT):
+            writer.write(b"%% not json %%\n")
+        await writer.drain()
+        return None            # then stall: the streak must break us
+
+    async def scenario():
+        stub = await StubReplica(behavior=behavior).start()
+        cli = await TcpSweepClient("127.0.0.1", stub.port).connect()
+        try:
+            resp = await cli.request(_sweep(0), timeout=30.0)
+            assert resp["ok"] is False
+            assert "torn" in resp["error"]["message"]
+            assert cli.torn_lines == serve_client.TORN_LINE_LIMIT
+        finally:
+            await cli.close()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+# -- circuit breaker unit ----------------------------------------------
+
+
+def test_breaker_lifecycle():
+    br = CircuitBreaker(fails=2, cooldown_s=0.01)
+    assert br.routable
+    br.record_failure()
+    assert br.routable            # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.routable
+    assert not br.probe_due()     # cooldown not burned yet
+    import time
+    time.sleep(0.02)
+    assert br.probe_due()
+    br.begin_probe()
+    assert br.state == "half-open"
+    br.probe_result(False)
+    assert br.state == "open"
+    time.sleep(0.02)
+    br.begin_probe()
+    br.probe_result(True)
+    assert br.state == "closed" and br.failures == 0
+    # One failure in half-open reopens immediately (no threshold).
+    br.record_failure()
+    br.record_failure()
+    time.sleep(0.02)
+    br.begin_probe()
+    br.record_failure()
+    assert br.state == "open"
+
+
+# -- router: routing, failover, admission ------------------------------
+
+
+def test_router_answers_and_hides_internals(short_budgets):
+    async def scenario():
+        replicas = [StubReplica(), StubReplica()]
+        sup, router = await _router_over(replicas)
+        try:
+            resp = await router.handle(_sweep(0))
+            assert resp["ok"] and resp["id"] == "r0"
+            assert "_replica_idx" not in resp
+            st = router.stats()
+            assert st["ok_total"] == 1 and st["availability"] == 1.0
+        finally:
+            await _teardown(router, replicas)
+    asyncio.run(scenario())
+
+
+def test_router_fails_over_losslessly(short_budgets):
+    async def scenario():
+        dead = StubReplica(behavior=drop_connection)
+        live = StubReplica()
+        sup, router = await _router_over([dead, live])
+        try:
+            resps = await asyncio.gather(*(
+                router.handle(_sweep(i)) for i in range(6)))
+            assert all(r["ok"] for r in resps)
+            st = router.stats()
+            assert st["failovers"] >= 1
+            assert st["retries"] >= 1
+            assert st["availability"] == 1.0
+            assert st["failover_p99_s"] is not None
+        finally:
+            await _teardown(router, replicas=[dead, live])
+    asyncio.run(scenario())
+
+
+def test_router_overload_then_breaker_recovery(short_budgets):
+    async def scenario():
+        replicas = [StubReplica(behavior=drop_connection),
+                    StubReplica(behavior=drop_connection)]
+        sup, router = await _router_over(replicas)
+        try:
+            resp = await router.handle(_sweep(0))
+            assert resp["ok"] is False
+            assert resp["error"]["code"] == E_INTERNAL
+            # Both breakers are open now: admission rejects with a
+            # structured overload, not a hang.
+            resp = await router.handle(_sweep(1))
+            assert resp["error"]["code"] == E_OVERLOADED
+            assert set(router.stats()["breakers"].values()) == {"open"}
+            # The replicas recover; the admission path itself kicks
+            # the half-open probes, so the router rediscovers them
+            # even while rejecting everything.
+            for r in replicas:
+                r.behavior = answer_sweep
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while True:
+                resp = await router.handle(_sweep(2))
+                if resp.get("ok"):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    f"router never recovered: {router.stats()}"
+                await asyncio.sleep(0.02)
+        finally:
+            await _teardown(router, replicas)
+    asyncio.run(scenario())
+
+
+def test_router_hedges_interactive_and_audits_duplicates(short_budgets):
+    async def slow_answer(payload, writer):
+        await asyncio.sleep(0.3)
+        return await answer_sweep(payload, writer)
+
+    async def scenario():
+        slow = StubReplica(behavior=slow_answer)
+        fast = StubReplica()
+        sup, router = await _router_over([slow, fast])
+        try:
+            resp = await router.handle(
+                _sweep(0, deadline_class="interactive"))
+            assert resp["ok"]
+            st = router.stats()
+            assert st["hedges"] >= 1
+            # The loser's late answer is suppressed and audited as
+            # bit-identical (deterministic same-width sweeps).
+            deadline = asyncio.get_running_loop().time() + 2.0
+            while router.stats()["duplicates"]["suppressed"] < 1:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            dup = router.stats()["duplicates"]
+            assert dup["mismatched"] == 0
+            assert dup["identical"] >= 1
+        finally:
+            await _teardown(router, replicas=[slow, fast])
+    asyncio.run(scenario())
+
+
+def test_router_inflight_cap_rejects_structured(short_budgets):
+    async def scenario():
+        slow = StubReplica(behavior=stay_silent)
+        sup, router = await _router_over([slow], max_inflight=1,
+                                         retries=0)
+        try:
+            first = asyncio.ensure_future(router.handle(_sweep(0)))
+            await asyncio.sleep(0.05)      # let it occupy the slot
+            resp = await router.handle(_sweep(1))
+            assert resp["error"]["code"] == E_OVERLOADED
+            assert "in-flight cap" in resp["error"]["message"]
+            first.cancel()
+            try:
+                await first
+            except asyncio.CancelledError:
+                pass
+        finally:
+            await _teardown(router, replicas=[slow])
+    asyncio.run(scenario())
+
+
+# -- router: connection-level chaos kinds ------------------------------
+
+
+def test_conn_reset_chaos_fails_over(short_budgets):
+    async def scenario():
+        replicas = [StubReplica(), StubReplica()]
+        sup, router = await _router_over(replicas)
+        plan = faults.FaultPlan([{"site": "router:dispatch:*",
+                                  "kind": "conn-reset", "times": 1}])
+        try:
+            with faults.fault_scope(plan):
+                resp = await router.handle(_sweep(0))
+            assert resp["ok"]
+            assert [e["kind"] for e in plan.log] == ["conn-reset"]
+            assert router.stats()["retries"] >= 1
+        finally:
+            await _teardown(router, replicas)
+    asyncio.run(scenario())
+
+
+def test_torn_line_chaos_recovers_under_budget(short_budgets):
+    async def scenario():
+        replicas = [StubReplica(), StubReplica()]
+        sup, router = await _router_over(replicas)
+        plan = faults.FaultPlan([{"site": "router:dispatch:*",
+                                  "kind": "torn-line", "times": 1}])
+        try:
+            with faults.fault_scope(plan):
+                resp = await router.handle(_sweep(0))
+            assert resp["ok"]
+            assert [e["kind"] for e in plan.log] == ["torn-line"]
+            # The replica saw one undecodable line (the torn write)
+            # and the router's retry answered the request anyway.
+            assert sum(r.bad_lines for r in replicas) == 1
+        finally:
+            await _teardown(router, replicas)
+    asyncio.run(scenario())
+
+
+# -- router: drain during failover (loss-free) -------------------------
+
+
+def test_drain_during_failover_answers_every_accepted(short_budgets):
+    async def slowish(payload, writer):
+        await asyncio.sleep(0.15)
+        return await answer_sweep(payload, writer)
+
+    async def scenario():
+        doomed = StubReplica(behavior=slowish)
+        live = StubReplica(behavior=slowish)
+        sup, router = await _router_over([doomed, live])
+        try:
+            accepted = [asyncio.ensure_future(
+                router.handle(_sweep(i))) for i in range(6)]
+            await asyncio.sleep(0.05)      # all dispatched, none done
+            drainer = asyncio.ensure_future(router.drain())
+            # Replica 0 dies mid-drain: its in-flight dispatches must
+            # fail over to the survivor, not be dropped.
+            doomed.up = False
+            sup.notify("down", 0)
+            await doomed.stop()
+            resps = await asyncio.gather(*accepted)
+            await drainer
+            assert all(r["ok"] for r in resps), resps
+            assert router.stats()["failovers"] >= 1
+            # Post-drain admission is a structured reject.
+            resp = await router.handle(_sweep(99))
+            assert resp["error"]["code"] == E_DRAINING
+        finally:
+            await router.stop()
+            await live.stop()
+    asyncio.run(scenario())
+
+
+def test_canonical_ignores_metadata():
+    a = {"ok": True, "result": {"x": 1}, "quarantine": None,
+         "lanes": None, "timing": {"total_s": 0.5}, "pack": {"k": 2}}
+    b = {"ok": True, "result": {"x": 1}, "quarantine": None,
+         "lanes": None, "timing": {"total_s": 9.9}, "pack": {"k": 4}}
+    c = {"ok": True, "result": {"x": 2}, "quarantine": None,
+         "lanes": None}
+    assert _canonical(a) == _canonical(b)
+    assert _canonical(a) != _canonical(c)
+
+
+# -- fleet supervisor over a stub subprocess ---------------------------
+
+
+STUB_REPLICA = textwrap.dedent("""
+    import json, socket, sys, threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    print(json.dumps({"serving": True, "host": "127.0.0.1",
+                      "port": srv.getsockname()[1]}), flush=True)
+
+    def serve(conn):
+        f = conn.makefile("rwb")
+        for line in f:
+            try:
+                req = json.loads(line)
+            except ValueError:
+                continue
+            f.write((json.dumps({"ok": True, "pong": True,
+                                 "id": req.get("id")}) + "\\n")
+                    .encode())
+            f.flush()
+
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(target=serve, args=(conn,),
+                         daemon=True).start()
+""")
+
+
+@pytest.fixture
+def stub_command(tmp_path):
+    path = tmp_path / "stub_replica.py"
+    path.write_text(STUB_REPLICA)
+    return [sys.executable, str(path)]
+
+
+def fast_fleet_config(stub_command, **overrides):
+    kw = dict(n_replicas=2, command=stub_command,
+              restart_base_delay_s=0.01, restart_max_delay_s=0.1,
+              ping_period_s=0.1, ping_misses=2, ping_timeout_s=1.0,
+              boot_timeout_s=30.0, stop_grace_s=5.0, tick_s=0.01)
+    kw.update(overrides)
+    return FleetConfig(**kw)
+
+
+async def _wait_for(cond, timeout_s=20.0, what="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while not cond():
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {what}"
+        await asyncio.sleep(0.02)
+
+
+def test_supervisor_boots_registers_and_restarts(stub_command):
+    async def scenario():
+        events = []
+        sup = ReplicaSupervisor(fast_fleet_config(stub_command))
+        sup.add_listener(events.append)
+        await sup.start()
+        try:
+            eps = sup.endpoints()
+            assert len(eps) == 2
+            assert all(e["incarnation"] == 1 for e in eps)
+            assert [e["event"] for e in events] == ["up", "up"]
+            # SIGKILL replica 0: classified signal-death (transient),
+            # restarted on backoff as a NEW incarnation on a new port.
+            old_port = sup.replicas[0].port
+            sup.replicas[0].proc.kill()
+            await _wait_for(
+                lambda: sup.replicas[0].incarnation == 2
+                and sup.replicas[0].routable,
+                what="replica 0 reboot")
+            assert sup.replicas[0].last_exit_kind == "signal-death"
+            assert sup.replicas[0].restarts == 1
+            assert sup.replicas[0].port != old_port
+            kinds = [e["event"] for e in events]
+            assert kinds == ["up", "up", "down", "up"]
+        finally:
+            await sup.stop()
+        assert all(r.proc is None or r.proc.returncode is not None
+                   for r in sup.replicas)
+    asyncio.run(scenario())
+
+
+def test_supervisor_enacts_chaos_kill_at_its_site(stub_command):
+    async def scenario():
+        sup = ReplicaSupervisor(fast_fleet_config(stub_command,
+                                                  n_replicas=1))
+        await sup.start()
+        plan = faults.FaultPlan([{"site": "router:replica:0",
+                                  "kind": "replica-crash",
+                                  "times": 1}])
+        try:
+            with faults.fault_scope(plan):
+                await _wait_for(
+                    lambda: sup.replicas[0].incarnation == 2
+                    and sup.replicas[0].routable,
+                    what="chaos kill + reboot")
+            assert [e["kind"] for e in plan.log] == ["replica-crash"]
+            assert sup.replicas[0].last_exit_kind == "signal-death"
+        finally:
+            await sup.stop()
+    asyncio.run(scenario())
+
+
+def test_supervisor_demotes_stalled_replica_then_reboots(stub_command):
+    async def scenario():
+        events = []
+        sup = ReplicaSupervisor(fast_fleet_config(stub_command,
+                                                  n_replicas=1))
+        sup.add_listener(events.append)
+        await sup.start()
+        try:
+            # SIGSTOP: alive but silent. Missed pings demote it
+            # (unroutable, announced), twice the miss budget kills it,
+            # and the exit path reboots a fresh incarnation.
+            sup.replicas[0].proc.send_signal(signal.SIGSTOP)
+            await _wait_for(
+                lambda: any(e["event"] == "down" for e in events),
+                what="demotion")
+            assert sup.endpoints() == []
+            await _wait_for(
+                lambda: sup.replicas[0].incarnation == 2
+                and sup.replicas[0].routable,
+                timeout_s=30.0, what="stall kill + reboot")
+        finally:
+            await sup.stop()
+    asyncio.run(scenario())
+
+
+def test_supervisor_abandons_crash_loops(tmp_path):
+    bad = tmp_path / "crash.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+
+    async def scenario():
+        events = []
+        sup = ReplicaSupervisor(fast_fleet_config(
+            [sys.executable, str(bad)], n_replicas=1, max_restarts=1,
+            restart_max_delay_s=0.02))
+        sup.add_listener(events.append)
+        with pytest.raises(RuntimeError, match="no replica came up"):
+            await sup.start()
+        try:
+            assert sup.replicas[0].state == "abandoned"
+            assert sup.replicas[0].last_exit_kind == "nonzero-exit"
+            assert events[-1]["event"] == "abandoned"
+        finally:
+            await sup.stop()
+    asyncio.run(scenario())
+
+
+# -- perfwatch tracks the fleet metrics --------------------------------
+
+
+def test_history_extracts_router_metrics():
+    from pycatkin_tpu.obs.history import TRACKED_METRICS, \
+        extract_metrics
+    assert TRACKED_METRICS["router_availability"] == "higher"
+    assert TRACKED_METRICS["failover_p99_s"] == "lower"
+    record = {"bench": "serve-chaos-drill",
+              "router": {"availability": 1.0,
+                         "failover_p99_s": 0.25}}
+    got = extract_metrics(record)
+    assert got["router_availability"] == 1.0
+    assert got["failover_p99_s"] == 0.25
+    # Absent sub-object -> absent metrics, not zeros.
+    assert "router_availability" not in extract_metrics({"bench": "x"})

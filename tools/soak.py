@@ -19,6 +19,14 @@ a pack; process 2 boots its server FROM that pack (compile count of
 its prewarm must be zero), streams N~64 requests, and gates on a 100%
 zero-compile rate, the p99 budget, response manifest/telemetry
 presence, and loss-free drain.
+
+``--chaos`` is the fleet-tier drill (``make router-check``): boot a
+3-replica pack-warmed fleet behind the router, SIGKILL 2 of 3
+replicas mid-soak plus one torn line and one connection reset, and
+hard-fail unless ZERO requests are lost, every answer is bitwise
+identical to an undisturbed same-grid run, the duplicate-suppression
+audit is clean, and the restarted replicas serve at a 100%
+zero-compile rate straight from the AOT pack.
 """
 
 from __future__ import annotations
@@ -121,10 +129,55 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _cmd_chaos(args) -> int:
+    """Fleet chaos drill; see module docstring and serve/soak.py."""
+    from pycatkin_tpu.serve.soak import check_chaos_record, \
+        run_chaos_drill
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    record = run_chaos_drill(
+        out_path=args.json, n_requests=args.n, bucket=args.bucket,
+        lanes=args.lanes, mechs=args.mechs_per_bucket,
+        n_replicas=args.replicas, kill=args.kill,
+        max_occupancy=args.max_occupancy, seed=args.seed,
+        with_pack=not args.no_pack, verbose=args.verbose)
+    router = record.get("router") or {}
+    print(json.dumps(record if args.full_json else {
+        "bench": record["bench"], "backend": record["backend"],
+        "n_requests": record["n_requests"], "n_ok": record["n_ok"],
+        "kills_fired": record["kills_fired"],
+        "incarnations": record["incarnations"],
+        "router": router, "wall_s": record["wall_s"]}, indent=2))
+    problems = check_chaos_record(record)
+    for p in problems:
+        print(f"chaos: GATE FAIL -- {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"chaos: OK -- {record['n_ok']}/{record['n_requests']} "
+          f"answered bit-identically while "
+          f"{record['kills_fired']}/{record['n_replicas']} replicas "
+          f"were killed and rebooted from the pack "
+          f"(availability={router.get('availability')}, "
+          f"failover_p99_s={router.get('failover_p99_s')})",
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--check", action="store_true",
                     help="two-process pack-boot CI gate")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fleet chaos drill: kill 2-of-3 replicas "
+                         "mid-soak, gate on loss-free bitwise-"
+                         "identical failover")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--kill", type=int, default=2)
+    ap.add_argument("--bucket", type=int, default=16,
+                    help="ABI bucket for the chaos drill grid")
+    ap.add_argument("--no-pack", action="store_true",
+                    help="chaos drill without the AOT boot pack "
+                         "(skips the zero-compile gate)")
     ap.add_argument("--n", type=int, default=1000)
     ap.add_argument("--buckets", default="16,32,128")
     ap.add_argument("--lanes", type=int, default=4)
@@ -152,6 +205,13 @@ def main(argv=None) -> int:
                          "(pack-booted server)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.chaos:
+        args.n = args.n if args.n != 1000 else 24
+        args.mechs_per_bucket = (args.mechs_per_bucket
+                                 if args.mechs_per_bucket != 6 else 4)
+        args.max_occupancy = (args.max_occupancy
+                              if args.max_occupancy != 8 else 4)
+        return _cmd_chaos(args)
     if args.check:
         args.n = args.n if args.n != 1000 else 64
         return _cmd_check(args)
