@@ -47,7 +47,12 @@
 # requests are lost, every answer is bitwise identical to an
 # undisturbed same-grid run, the duplicate-suppression audit is clean,
 # and the restarted replicas serve from the AOT pack at a 100%
-# zero-compile rate.
+# zero-compile rate. The drill by default ALSO SIGKILLs the
+# journal-backed front router mid-stream and gates on a loss-free,
+# bitwise-identical journal replay. `durable-check` is the JAX-free
+# durable-serving smoke (docs/serving.md "Durable requests"): a
+# write-ahead journal round-trip through rotation, compaction and a
+# torn tail, plus a router-kill replay over stub replicas.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
@@ -55,7 +60,7 @@ PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 .PHONY: test test-faults test-validate test-sharded test-san test-all \
 	lint lint-faults lint-syncs lint-baseline bench-smoke \
 	aot-pack-selftest obs-check perfwatch chaos serve-check \
-	router-check
+	router-check durable-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -121,3 +126,6 @@ serve-check:
 
 router-check:
 	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python tools/soak.py --chaos
+
+durable-check:
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python tools/soak.py --durable

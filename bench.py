@@ -923,6 +923,23 @@ def smoke_main():
         router = router_rec.get("router") or {}
         router_ok = not router_problems
 
+        # Durable gate (ISSUE-17): the durable-serving smoke -- a
+        # mini journal round-trip (rotation, compaction, torn-tail
+        # replay) plus a router-kill replay over stub replicas,
+        # gated on bitwise journal-served duplicates and a fully
+        # re-answered backlog. JAX-free, runs in well under a second;
+        # its replay/recovery walls feed the perfwatch history
+        # (router_recovery_s / journal_replay_s).
+        from pycatkin_tpu.serve.soak import (check_durable_record,
+                                             run_durable_smoke)
+        try:
+            durable_rec = run_durable_smoke()
+            durable_problems = check_durable_record(durable_rec)
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            durable_rec = {"error": str(e)}
+            durable_problems = [f"durable smoke crashed: {e}"]
+        durable_ok = not durable_problems
+
         # Sanitizer gate (ISSUE-14, pcsan): the same 8x8 sweep once
         # more with all three runtime tripwires armed -- recompile
         # (one recording pass, then mark_warm: a warm cell must
@@ -1107,6 +1124,17 @@ def smoke_main():
         "serve_ok": serve_ok,
         "router": router,
         "router_ok": router_ok,
+        "durable": {
+            "roundtrip": durable_rec.get("roundtrip"),
+            "replay": durable_rec.get("replay"),
+            "dup": durable_rec.get("dup"),
+            "router_recovery_s": (durable_rec.get("replay")
+                                  or {}).get("router_recovery_s"),
+            "journal_replay_s": (durable_rec.get("replay")
+                                 or {}).get("wall_s"),
+            "error": durable_rec.get("error"),
+        },
+        "durable_ok": durable_ok,
         "san_ok": san_ok,
         "san_error": san_err,
         "lint_ok": True,
@@ -1178,6 +1206,10 @@ def smoke_main():
     if not router_ok:
         log(f"bench-smoke: FAIL -- router gate: "
             f"{'; '.join(router_problems)}")
+        return 1
+    if not durable_ok:
+        log(f"bench-smoke: FAIL -- durable gate: "
+            f"{'; '.join(durable_problems)}")
         return 1
     if not san_ok:
         log(f"bench-smoke: FAIL -- sanitizer gate (pcsan): {san_err}")

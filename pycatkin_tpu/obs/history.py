@@ -40,6 +40,10 @@ TRACKED_METRICS = {
     # gate; pulled from the record's "router" sub-object).
     "router_availability": "higher",  # answered-ok fraction under chaos
     "failover_p99_s": "lower",        # tail failure-to-answer latency
+    # Durable-serving SLOs (chaos-drill router-crash mode and bench
+    # smoke's durable gate; pulled from the "durable" sub-object).
+    "router_recovery_s": "lower",     # SIGKILL-to-routable router wall
+    "journal_replay_s": "lower",      # boot replay of the WAL backlog
 }
 
 # A regression must clear BOTH gates: beyond ``mad_k`` median absolute
@@ -81,12 +85,15 @@ def extract_metrics(record: dict) -> dict:
     metrics fall back to the ``serve`` sub-object a serve-soak record
     (or the smoke gate) nests them under; ``router_availability`` /
     ``failover_p99_s`` likewise fall back to the ``router``
-    sub-object of a chaos-drill record."""
+    sub-object of a chaos-drill record, and ``router_recovery_s`` /
+    ``journal_replay_s`` to its ``durable`` sub-object."""
     rec = _unwrap(record)
     serve = rec.get("serve") if isinstance(rec.get("serve"),
                                            dict) else {}
     router = rec.get("router") if isinstance(rec.get("router"),
                                              dict) else {}
+    durable = rec.get("durable") if isinstance(rec.get("durable"),
+                                               dict) else {}
     out = {}
     for key in TRACKED_METRICS:
         v = rec.get(key)
@@ -99,6 +106,9 @@ def extract_metrics(record: dict) -> dict:
             v = router.get("availability")
         if v is None and key == "failover_p99_s":
             v = router.get("failover_p99_s")
+        if v is None and key in ("router_recovery_s",
+                                 "journal_replay_s"):
+            v = durable.get(key)
         try:
             f = float(v)
         except (TypeError, ValueError):

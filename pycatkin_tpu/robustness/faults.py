@@ -80,12 +80,13 @@ ENV_VAR = "PYCATKIN_FAULTS"
 
 _KINDS = ("transient", "permanent", "nan", "stall",
           "worker-crash", "heartbeat-stall", "slow-worker",
-          "replica-crash", "replica-stall", "conn-reset", "torn-line")
+          "replica-crash", "replica-stall", "conn-reset", "torn-line",
+          "router-crash")
 
 # Kinds enacted by the serve tier itself (fleet supervisor / front
 # router) via take(), never by on_call.
 EXTERNAL_KINDS = ("replica-crash", "replica-stall", "conn-reset",
-                  "torn-line")
+                  "torn-line", "router-crash")
 
 
 class InjectedDeviceLossError(RuntimeError):

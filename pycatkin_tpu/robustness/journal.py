@@ -92,7 +92,8 @@ class SweepJournal:
                     f"journal already exists at {self.manifest_path}; "
                     "pass resume=True to continue it (or use a fresh "
                     "directory)")
-            self._records = read_json_lines(self.manifest_path)
+            self._records = read_json_lines(self.manifest_path,
+                                            tolerate_torn_tail=True)
         header = next((r for r in self._records
                        if r.get("kind") == "header"), None)
         if header is None:

@@ -19,10 +19,19 @@ Above the single server sits the fleet tier (PR 16): a
 server replicas alive, and a :class:`SweepRouter` (serve/router.py)
 multiplexing clients across them with circuit breakers, SLA-budgeted
 retries, hedged interactive dispatch and loss-free failover.
+
+PR 17 makes the front tier durable: a :class:`RequestJournal`
+(serve/durable.py) write-ahead-logs every keyed request before it is
+acknowledged, so a SIGKILLed router -- restarted by a
+``FleetConfig(role="router")`` supervisor and re-reading the replica
+endpoints through :class:`FileFleet` -- replays its
+accepted-but-unanswered backlog and serves bitwise-identical journaled
+answers for duplicate idempotency keys.
 """
 
 from .client import SweepClient, TcpSweepClient
-from .fleet import FleetConfig, ReplicaSupervisor
+from .durable import RequestJournal
+from .fleet import FileFleet, FleetConfig, ReplicaSupervisor
 from .protocol import (DEADLINE_CLASSES, ServeConfig, ServeError,
                        error_response)
 from .router import RouterConfig, SweepRouter
@@ -31,4 +40,5 @@ from .server import SweepServer
 __all__ = ["SweepServer", "SweepClient", "TcpSweepClient",
            "ServeConfig", "ServeError", "DEADLINE_CLASSES",
            "error_response", "ReplicaSupervisor", "FleetConfig",
-           "SweepRouter", "RouterConfig"]
+           "SweepRouter", "RouterConfig", "RequestJournal",
+           "FileFleet"]

@@ -4,6 +4,13 @@ drained (SIGINT/SIGTERM trigger the graceful drain path).
 Configuration comes from the ``PYCATKIN_SERVE_*`` environment knobs
 (docs/index.md registry) and the flags below; the bound port is
 printed as a JSON line on stdout so a supervisor can scrape it.
+
+``--router`` runs the FRONT ROUTER instead (serve/router.py): a
+JAX-free process that routes to the replica endpoints published in
+``--fleet-file`` (or ``PYCATKIN_ROUTER_FLEET_FILE``), optionally
+journal-backed via ``--journal-dir`` / ``PYCATKIN_DURABLE_DIR`` so a
+supervised router (``FleetConfig(role="router")``) replays its
+accepted-but-unanswered backlog after a crash.
 """
 
 from __future__ import annotations
@@ -11,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import signal
 import sys
 
@@ -49,6 +57,46 @@ async def _amain(args) -> int:
     return 0
 
 
+async def _amain_router(args) -> int:
+    from .fleet import FLEET_FILE_ENV, FileFleet
+    from .router import RouterConfig, SweepRouter
+
+    fleet_file = args.fleet_file or os.environ.get(FLEET_FILE_ENV)
+    if not fleet_file:
+        print("--router requires --fleet-file (or "
+              f"{FLEET_FILE_ENV})", file=sys.stderr)
+        return 2
+    cfg = RouterConfig(host=args.host or "127.0.0.1",
+                       port=args.port or 0,
+                       journal_dir=args.journal_dir)
+    router = await SweepRouter(FileFleet(fleet_file), cfg).start()
+    # The serving line is scraped by a role="router" supervisor, the
+    # same way replica lines are; journal replay is already running in
+    # the background at this point (progress via the stats op).
+    print(json.dumps({"serving": True, "router": True,
+                      "host": cfg.host, "port": router.port}),
+          flush=True)
+
+    loop = asyncio.get_running_loop()
+    draining = asyncio.Event()
+
+    def _trigger_drain():
+        if not draining.is_set():
+            draining.set()
+            loop.create_task(router.drain())
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, _trigger_drain)
+        except (NotImplementedError, OSError):
+            pass
+    while router._tcp_server is not None:
+        await asyncio.sleep(0.1)
+    print(json.dumps({"serving": False, "router": True,
+                      "stats": router.stats()}), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pycatkin_tpu.serve",
@@ -62,7 +110,17 @@ def main(argv=None) -> int:
                     help="AOT cache pack imported before listening")
     ap.add_argument("--work-dir", default=None)
     ap.add_argument("--max-occupancy", type=int, default=None)
+    ap.add_argument("--router", action="store_true",
+                    help="run the front router instead of a replica")
+    ap.add_argument("--fleet-file", default=None,
+                    help="router mode: endpoints file published by "
+                         "the replica supervisor")
+    ap.add_argument("--journal-dir", default=None,
+                    help="router mode: write-ahead request journal "
+                         "directory (enables durable requests)")
     args = ap.parse_args(argv)
+    if args.router:
+        return asyncio.run(_amain_router(args))
     return asyncio.run(_amain(args))
 
 

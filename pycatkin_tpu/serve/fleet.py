@@ -32,6 +32,17 @@ Chaos: each monitor tick polls :func:`robustness.faults.take` at its
 kinds and enacts what fires (``replica-crash`` = SIGKILL,
 ``replica-stall`` = SIGSTOP). ``times=N`` budgets hold fleet-wide
 through the plan's O_EXCL ticket files.
+
+Router supervision (``FleetConfig(role="router")``): the same
+machinery keeps the FRONT ROUTER alive -- one supervised
+``python -m pycatkin_tpu.serve --router`` subprocess under the same
+backoff/abandon/registration policy, polled at the ``router:front``
+chaos site for the ``router-crash`` kind (SIGKILL). The parent
+publishes its replica endpoints to an atomically-written JSON file
+(``FleetConfig.endpoints_file``, tmp + ``os.replace``) that the router
+subprocess consumes through :class:`FileFleet`; a rebooted router
+re-reads the file, replays its request journal (serve/durable.py) and
+rebinds the SAME fixed port so clients reconnect.
 """
 
 from __future__ import annotations
@@ -56,10 +67,13 @@ REPLICAS_ENV = "PYCATKIN_ROUTER_REPLICAS"
 MAX_RESTARTS_ENV = "PYCATKIN_ROUTER_MAX_RESTARTS"
 PING_PERIOD_ENV = "PYCATKIN_ROUTER_PING_PERIOD_S"
 PING_MISSES_ENV = "PYCATKIN_ROUTER_PING_MISSES"
+FLEET_FILE_ENV = "PYCATKIN_ROUTER_FLEET_FILE"
 
 # The serve-tier chaos kinds THIS tier enacts (the router enacts the
-# connection-level ones at its dispatch sites).
+# connection-level ones at its dispatch sites). A role="router"
+# supervisor polls for the router-death kind instead.
 SUPERVISOR_FAULT_KINDS = ("replica-crash", "replica-stall")
+ROUTER_SUPERVISOR_FAULT_KINDS = ("router-crash",)
 
 _STDERR_TAIL_LINES = 40
 
@@ -83,10 +97,25 @@ class FleetConfig:
     stop_grace_s: float = 30.0
     tick_s: float = 0.02
     host: str = "127.0.0.1"
+    # "replica" supervises SweepServer subprocesses; "router"
+    # supervises one front-router subprocess (router-crash drills).
+    role: str = "replica"
+    # Atomic endpoints-file publication for an out-of-process router
+    # (consumed via FileFleet); None disables.
+    endpoints_file: Optional[str] = None
 
     def __post_init__(self):
+        if self.role not in ("replica", "router"):
+            raise ValueError(f"role must be 'replica' or 'router', "
+                             f"got {self.role!r}")
         if self.n_replicas is None:
-            self.n_replicas = int(os.environ.get(REPLICAS_ENV, "3"))
+            if self.role == "router":
+                # One front router per fleet: a second would race for
+                # the same fixed port.
+                self.n_replicas = 1
+            else:
+                self.n_replicas = int(os.environ.get(REPLICAS_ENV,
+                                                     "3"))
         if self.max_restarts is None:
             self.max_restarts = int(os.environ.get(MAX_RESTARTS_ENV,
                                                    "5"))
@@ -218,11 +247,24 @@ class ReplicaSupervisor:
 
     def _notify(self, event: str, r: Replica) -> None:
         self._set_up_gauge()
+        self._publish_endpoints()
         info = {"event": event, "idx": r.idx,
                 "incarnation": r.incarnation, "host": self.config.host,
                 "port": r.port}
         for fn in list(self._listeners):
             fn(dict(info))
+
+    def _publish_endpoints(self) -> None:
+        """Republish the routable set to ``endpoints_file`` (tmp +
+        ``os.replace``, so an out-of-process FileFleet reader never
+        sees a half-written file)."""
+        path = self.config.endpoints_file
+        if not path:
+            return
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"endpoints": self.endpoints()}, fh)
+        os.replace(tmp, path)
 
     def _set_up_gauge(self) -> None:
         _metrics.gauge("pycatkin_router_replicas_up",
@@ -258,10 +300,14 @@ class ReplicaSupervisor:
                     return
                 await self._spawn(r)
                 continue
-            site = f"router:replica:{r.idx}"
-            for spec in faults.take(site,
-                                    kinds=SUPERVISOR_FAULT_KINDS):
-                self._enact(r, spec.kind)
+            if self.config.role == "router":
+                site = "router:front"
+                kinds = ROUTER_SUPERVISOR_FAULT_KINDS
+            else:
+                site = f"router:replica:{r.idx}"
+                kinds = SUPERVISOR_FAULT_KINDS
+            for spec in faults.take(site, kinds=kinds):
+                self._enact(r, spec.kind, site)
             if r.proc.returncode is not None:
                 await self._handle_exit(r)
                 continue
@@ -271,14 +317,14 @@ class ReplicaSupervisor:
                 await self._probe(r)
             await asyncio.sleep(cfg.tick_s)
 
-    def _enact(self, r: Replica, kind: str) -> None:
-        """Enact one externally-enacted chaos kind on a live replica."""
+    def _enact(self, r: Replica, kind: str, site: str) -> None:
+        """Enact one externally-enacted chaos kind on a live child."""
         if r.proc is None or r.proc.returncode is not None:
             return
         record_event("router", action="chaos-enact", replica=r.idx,
-                     label=f"router:replica:{r.idx}", fault_kind=kind)
+                     label=site, fault_kind=kind)
         try:
-            if kind == "replica-crash":
+            if kind in ("replica-crash", "router-crash"):
                 r.proc.kill()                       # SIGKILL, no drain
             elif kind == "replica-stall":
                 r.proc.send_signal(signal.SIGSTOP)  # alive, silent
@@ -290,6 +336,14 @@ class ReplicaSupervisor:
     def _command(self) -> list:
         if self.config.command:
             return list(self.config.command)
+        if self.config.role == "router":
+            # A supervised router must sit on a FIXED port so clients
+            # reconnect to the same address across incarnations; pass
+            # an explicit command (or env PYCATKIN_SERVE_PORT) rather
+            # than relying on this ephemeral-port default.
+            return [sys.executable, "-m", "pycatkin_tpu.serve",
+                    "--router", "--host", self.config.host,
+                    "--port", "0"]
         return [sys.executable, "-m", "pycatkin_tpu.serve",
                 "--host", self.config.host, "--port", "0"]
 
@@ -452,3 +506,47 @@ class ReplicaSupervisor:
         r.state = "dead"
         if was_routable:
             self._notify("down", r)
+
+
+class FileFleet:
+    """The supervisor surface a :class:`serve.router.SweepRouter`
+    consumes (``endpoints()`` / ``stats()`` / ``add_listener``),
+    backed by the endpoints file a ReplicaSupervisor in ANOTHER
+    process publishes (``FleetConfig.endpoints_file``). This is how a
+    supervised router subprocess routes to replicas owned by its
+    parent: the parent republishes atomically on every routability
+    change, and incarnation bumps in the file retire stale links in
+    the router's ``_link_for``. Listeners never fire -- cross-process
+    routability changes surface through the file (and through link
+    failures), not callbacks."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._sig = None
+        self._cache: list = []
+
+    def add_listener(self, fn) -> None:
+        pass   # see the class docstring: the file IS the event stream
+
+    def endpoints(self) -> list:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig != self._sig:
+            try:
+                with open(self.path) as fh:
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                # Mid-replace race or unreadable file: keep the last
+                # good snapshot; the next call re-reads.
+                return [dict(ep) for ep in self._cache]
+            self._cache = list(data.get("endpoints", []))
+            self._sig = sig
+        return [dict(ep) for ep in self._cache]
+
+    def stats(self) -> dict:
+        eps = self.endpoints()
+        return {"n_replicas": len(eps), "up": len(eps),
+                "replicas": [], "endpoints_file": self.path}

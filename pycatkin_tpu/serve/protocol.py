@@ -1,16 +1,26 @@
 """Wire schema and knobs of the serving layer (docs/serving.md).
 
 One JSON object per line, both directions. Requests carry ``op``
-("sweep" | "ping" | "stats" | "drain") and, for sweeps, a mechanism in
-the reference input-file schema (utils/io.system_to_dict), a
-conditions grid, and a deadline class. Responses echo the request
+("sweep" | "ping" | "stats" | "drain" | "result") and, for sweeps, a
+mechanism in the reference input-file schema (utils/io.system_to_dict),
+a conditions grid, and a deadline class. Responses echo the request
 ``id`` and either ``ok: true`` with the result payload or ``ok: false``
 with a structured error -- admission control rejects are data, not
 dropped connections.
+
+Durable extension (docs/serving.md "Durable requests"): a sweep may
+carry an optional client-chosen ``idempotency_key``. Against a
+journal-backed router the client then receives an out-of-band
+``{"accepted": true, "key": ...}`` ack line once the request is
+fsynced to the write-ahead journal, and a ``result`` op
+(``{"op": "result", "key": ...}``) fetches the journaled answer for a
+key. Keyless requests are byte-identical to the pre-durability
+protocol.
 """
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass, field
 from typing import Optional
@@ -32,6 +42,14 @@ BUDGET_BATCH_ENV = "PYCATKIN_SERVE_BUDGET_BATCH"
 TIMEOUT_INTERACTIVE_ENV = "PYCATKIN_SERVE_TIMEOUT_INTERACTIVE"
 TIMEOUT_STANDARD_ENV = "PYCATKIN_SERVE_TIMEOUT_STANDARD"
 TIMEOUT_BATCH_ENV = "PYCATKIN_SERVE_TIMEOUT_BATCH"
+
+# Durable-request knobs (serve/durable.py, docs/serving.md): where the
+# router's write-ahead request journal lives, how large a journal
+# segment may grow before rotation, and how many journaled requests
+# the boot-time replay re-dispatches concurrently.
+DURABLE_DIR_ENV = "PYCATKIN_DURABLE_DIR"
+DURABLE_SEGMENT_BYTES_ENV = "PYCATKIN_DURABLE_SEGMENT_BYTES"
+DURABLE_REPLAY_CONCURRENCY_ENV = "PYCATKIN_DURABLE_REPLAY_CONCURRENCY"
 
 _DEFAULT_BUDGETS = {"interactive": 0.02, "standard": 0.2, "batch": 2.0}
 _BUDGET_ENVS = {"interactive": BUDGET_INTERACTIVE_ENV,
@@ -57,6 +75,13 @@ E_OVERLOADED = "overloaded"
 E_DRAINING = "draining"
 E_INTERNAL = "internal"
 E_TIMEOUT = "timeout"
+# The transport under an in-flight request died (TCP client): the
+# error names the peer and whether the request carried an idempotency
+# key, so callers know a resubmit is safe.
+E_CONN_LOST = "conn_lost"
+# A ``result`` fetch named a key the journal has no answer for (never
+# accepted, still in flight, or already compacted away).
+E_UNKNOWN_KEY = "unknown_key"
 
 
 class ServeError(Exception):
@@ -164,9 +189,36 @@ def jsonable(obj):
     return repr(obj)
 
 
-def error_response(req_id, code: str, message: str) -> dict:
+def error_response(req_id, code: str, message: str, **extra) -> dict:
+    """Structured error line. ``extra`` keys (e.g. ``peer``,
+    ``idempotency_key`` on ``conn_lost``) ride inside the ``error``
+    object; legacy callers pass none and the shape is unchanged."""
+    err = {"code": code, "message": message}
+    if extra:
+        err.update(extra)
     return {"protocol": PROTOCOL, "id": req_id, "ok": False,
-            "error": {"code": code, "message": message}}
+            "error": err}
+
+
+def accepted_ack(req_id, key: str) -> dict:
+    """The durability ack: written to the socket only AFTER the
+    ``accepted`` journal record is fsynced, it promises the keyed
+    request will be answered exactly once even across router death."""
+    return {"protocol": PROTOCOL, "id": req_id, "accepted": True,
+            "key": key}
+
+
+def canonical_answer(resp: dict) -> str:
+    """Canonical form of a sweep answer for bitwise-identity audits:
+    the duplicate-suppression audit (hedge losers, failover stragglers,
+    serve/router.py), the journaled-answer replay audit
+    (serve/durable.py) and the chaos drill all compare THIS string.
+    Covers the solver-derived payload; per-request envelope fields
+    (``id``, ``timing``, ``pack``) legitimately differ between
+    duplicates and are excluded."""
+    return json.dumps({"result": resp.get("result"),
+                       "quarantine": resp.get("quarantine"),
+                       "lanes": resp.get("lanes")}, sort_keys=True)
 
 
 def parse_sweep_request(payload: dict) -> dict:
@@ -210,7 +262,15 @@ def parse_sweep_request(payload: dict) -> dict:
     if not isinstance(want, (list, tuple)):
         raise ServeError(E_BAD_REQUEST, "/return: expected a list of "
                          "result keys (e.g. [\"y\"])")
+    key = payload.get("idempotency_key")
+    if key is not None:
+        if not isinstance(key, str) or not key:
+            raise ServeError(E_BAD_REQUEST, "/idempotency_key: "
+                             "expected a non-empty string")
+        if len(key) > 256:
+            raise ServeError(E_BAD_REQUEST, "/idempotency_key: "
+                             "longer than 256 characters")
     return {"mechanism": mech, "T": T, "p": p,
             "tof_terms": list(tof_terms) if tof_terms else None,
             "deadline_class": str(cls), "wait_budget_s": wait,
-            "want": [str(k) for k in want]}
+            "want": [str(k) for k in want], "idempotency_key": key}

@@ -38,6 +38,15 @@ Chaos: each dispatch polls :func:`robustness.faults.take` at its
 ``router:dispatch:<i>`` site for the connection-level kinds and enacts
 them itself (``conn-reset`` aborts the replica link, ``torn-line``
 truncates the dispatch's wire line mid-object).
+
+Durability (docs/serving.md "Durable requests"): with a journal dir
+configured (``PYCATKIN_DURABLE_DIR``), sweeps carrying an
+``idempotency_key`` are write-ahead journaled (``serve/durable.py``):
+the ``accepted`` record is fsynced before the ack line reaches the
+socket, the answer is journaled before the client can see it, boot
+replays the journal and re-dispatches the accepted-but-unanswered
+backlog, and duplicate keys are answered bitwise from the journal.
+Keyless requests take the legacy path untouched.
 """
 
 from __future__ import annotations
@@ -54,8 +63,11 @@ from typing import Optional
 from ..obs import metrics as _metrics
 from ..utils.profiling import record_event
 from ..utils.retry import backoff_delay, is_transient_backend_error
-from .protocol import (E_BAD_REQUEST, E_DRAINING, E_INTERNAL,
-                       E_OVERLOADED, E_TIMEOUT, PROTOCOL, ServeError,
+from .durable import RequestJournal
+from .protocol import (DURABLE_DIR_ENV, DURABLE_REPLAY_CONCURRENCY_ENV,
+                       E_BAD_REQUEST, E_DRAINING, E_INTERNAL,
+                       E_OVERLOADED, E_TIMEOUT, E_UNKNOWN_KEY, PROTOCOL,
+                       ServeError, accepted_ack, canonical_answer,
                        error_response, request_timeout_for)
 
 # Env knobs (PCL006 registry rows in docs/index.md).
@@ -93,6 +105,12 @@ class RouterConfig:
     connect_timeout_s: float = 2.0
     probe_timeout_s: float = 2.0
     tick_s: float = 0.02
+    # Durability (docs/serving.md "Durable requests"): a journal dir
+    # enables the write-ahead request journal; unset (and no
+    # PYCATKIN_DURABLE_DIR in the environment) leaves the router
+    # memory-only with byte-identical legacy behavior.
+    journal_dir: Optional[str] = None
+    replay_concurrency: Optional[int] = None
 
     def __post_init__(self):
         env = os.environ.get
@@ -109,6 +127,14 @@ class RouterConfig:
             self.hedge_min_s = float(env(HEDGE_MIN_ENV, "0.05"))
         if self.retries is None:
             self.retries = int(env(RETRIES_ENV, "3"))
+        if self.journal_dir is None:
+            self.journal_dir = env(DURABLE_DIR_ENV) or None
+        if self.replay_concurrency is None:
+            self.replay_concurrency = int(
+                env(DURABLE_REPLAY_CONCURRENCY_ENV, "4"))
+        if self.replay_concurrency < 1:
+            raise ValueError(f"replay_concurrency must be >= 1, "
+                             f"got {self.replay_concurrency}")
         if self.max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, "
                              f"got {self.max_inflight}")
@@ -324,6 +350,18 @@ class SweepRouter:
         self._dup_suppressed = 0
         self._dup_identical = 0
         self._dup_mismatched = 0
+        # Durable-request state (docs/serving.md "Durable requests").
+        # Constructing the journal replays its on-disk segments, so a
+        # rebooted router knows its accepted-but-unanswered backlog
+        # before it serves a single request.
+        self._journal = (RequestJournal(self.config.journal_dir)
+                         if self.config.journal_dir else None)
+        self._keyed_inflight: dict = {}   # key -> future -> response
+        self._dup_served = 0
+        self._dup_coalesced = 0
+        self._replay_task = None
+        self._replay_stats = {"total": 0, "done": 0, "failed": 0,
+                              "active": False, "wall_s": None}
         supervisor.add_listener(self._on_fleet_event)
 
     # -- lifecycle -----------------------------------------------------
@@ -336,6 +374,13 @@ class SweepRouter:
             self.port = self._tcp_server.sockets[0].getsockname()[1]
             record_event("router", action="listen",
                          host=self.config.host, port=self.port)
+        if self._journal is not None:
+            pending = self._journal.unanswered()
+            self._replay_stats["total"] = len(pending)
+            if pending:
+                self._replay_stats["active"] = True
+                self._replay_task = asyncio.get_running_loop() \
+                    .create_task(self._replay_pending(pending))
         return self
 
     async def drain(self) -> None:
@@ -356,6 +401,13 @@ class SweepRouter:
 
     async def stop(self) -> None:
         self._draining = True
+        if self._replay_task is not None:
+            self._replay_task.cancel()
+            try:
+                await self._replay_task
+            except asyncio.CancelledError:
+                pass
+            self._replay_task = None
         if self._tcp_server is not None:
             self._tcp_server.close()
             await self._tcp_server.wait_closed()
@@ -374,6 +426,59 @@ class SweepRouter:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    # -- boot-time journal replay --------------------------------------
+
+    async def _replay_pending(self, pending: list) -> None:
+        """Recovery after router death: every journaled
+        accepted-but-unanswered request is re-dispatched to the fleet
+        (bounded concurrency) through the ordinary keyed sweep path,
+        so its answer lands in the journal and duplicate resubmissions
+        from reconnecting clients coalesce onto the same dispatch."""
+        t0 = time.monotonic()
+        record_event("durable", action="replay-begin",
+                     pending=len(pending))
+
+        sem = asyncio.Semaphore(self.config.replay_concurrency)
+
+        async def one(n: int, key: str, payload) -> None:
+            async with sem:
+                if self._journal.answered_response(key) is not None:
+                    self._replay_stats["done"] += 1
+                    return   # a client resubmission beat us to it
+                req = dict(payload) if isinstance(payload, dict) else {}
+                req["idempotency_key"] = key
+                req.setdefault("op", "sweep")
+                req["id"] = f"replay-{n}"
+                resp = None
+                # A replica breaker may still be warming right after
+                # boot; overload rejects here would silently park the
+                # request until the NEXT boot, so back off and retry.
+                for attempt in range(8):
+                    resp = await self.handle(req)
+                    code = ((resp.get("error") or {}).get("code")
+                            if not resp.get("ok") else None)
+                    if code != E_OVERLOADED:
+                        break
+                    await asyncio.sleep(backoff_delay(attempt, 0.1,
+                                                      2.0))
+                if resp is not None and resp.get("ok"):
+                    self._replay_stats["done"] += 1
+                else:
+                    self._replay_stats["failed"] += 1
+
+        try:
+            await asyncio.gather(
+                *(one(n, key, payload)
+                  for n, (key, payload) in enumerate(pending)),
+                return_exceptions=True)
+        finally:
+            self._replay_stats["active"] = False
+            self._replay_stats["wall_s"] = time.monotonic() - t0
+            record_event("durable", action="replay-complete",
+                         done=self._replay_stats["done"],
+                         failed=self._replay_stats["failed"],
+                         wall_s=self._replay_stats["wall_s"])
 
     # -- fleet events --------------------------------------------------
 
@@ -529,7 +634,7 @@ class SweepRouter:
 
     # -- request handling ----------------------------------------------
 
-    async def handle(self, payload) -> dict:
+    async def handle(self, payload, ack=None) -> dict:
         req_id = payload.get("id") if isinstance(payload, dict) else None
         try:
             if not isinstance(payload, dict):
@@ -550,8 +655,10 @@ class SweepRouter:
                 asyncio.get_running_loop().create_task(self.drain())
                 return {"protocol": PROTOCOL, "id": req_id, "ok": True,
                         "draining": True}
+            if op == "result":
+                return self._fetch_result(payload, req_id)
             if op == "sweep":
-                return await self._route_sweep(payload, req_id)
+                return await self._route_sweep(payload, req_id, ack)
             raise ServeError(E_BAD_REQUEST, f"unknown op {op!r}")
         except ServeError as exc:
             return error_response(req_id, exc.code, str(exc))
@@ -559,8 +666,63 @@ class SweepRouter:
             return error_response(req_id, E_INTERNAL,
                                   f"{type(exc).__name__}: {exc}")
 
-    async def _route_sweep(self, payload: dict, req_id) -> dict:
+    def _fetch_result(self, payload: dict, req_id) -> dict:
+        """``{"op": "result", "key": ...}``: fetch the journaled answer
+        for an idempotency key -- how a reconnecting client retrieves
+        an answer it may have missed, without re-running anything."""
+        if self._journal is None:
+            raise ServeError(E_BAD_REQUEST,
+                             "durability is not enabled on this router "
+                             "(no journal dir)")
+        key = payload.get("key")
+        if not isinstance(key, str) or not key:
+            raise ServeError(E_BAD_REQUEST,
+                             "/key: expected a non-empty string")
+        stored = self._journal.answered_response(key)
+        if stored is not None:
+            return dict(stored, id=req_id)
+        if key in self._keyed_inflight or self._journal.is_accepted(key):
+            raise ServeError(E_UNKNOWN_KEY,
+                             f"key {key!r} is accepted but not yet "
+                             "answered; retry shortly")
+        raise ServeError(E_UNKNOWN_KEY,
+                         f"no journaled answer for key {key!r} (never "
+                         "accepted, or compacted out of the window)")
+
+    async def _route_sweep(self, payload: dict, req_id,
+                           ack=None) -> dict:
         cls = str(payload.get("deadline_class", "standard"))
+        key = payload.get("idempotency_key")
+        key = (str(key) if key is not None and self._journal is not None
+               else None)
+        if key is not None:
+            # Duplicate of an answered key: serve the journaled answer
+            # bitwise (only the id is rewritten) -- even while
+            # draining, a replayed answer is a read, not new work.
+            stored = self._journal.answered_response(key)
+            if stored is not None:
+                self._dup_served += 1
+                _metrics.counter(
+                    "pycatkin_durable_duplicates_served_total",
+                    "keyed duplicates answered from the journal").inc()
+                record_event("durable", action="dup-served", key=key)
+                return dict(stored, id=req_id)
+            inflight_fut = self._keyed_inflight.get(key)
+            if inflight_fut is not None:
+                # Same key already being dispatched (client
+                # resubmission racing the original or the boot-time
+                # replay): coalesce onto one dispatch.
+                self._dup_coalesced += 1
+                try:
+                    resp = await asyncio.wait_for(
+                        asyncio.shield(inflight_fut),
+                        request_timeout_for(cls))
+                except asyncio.TimeoutError:
+                    raise ServeError(
+                        E_TIMEOUT,
+                        f"coalesced dispatch for key {key!r} burned "
+                        "the SLA budget") from None
+                return dict(resp, id=req_id)
         if self._draining:
             raise ServeError(E_DRAINING,
                              "router is draining; no new sweeps")
@@ -574,6 +736,25 @@ class SweepRouter:
             raise ServeError(E_OVERLOADED,
                              "every replica breaker is open; "
                              "retry with backoff")
+        keyed_fut = None
+        if key is not None:
+            keyed_fut = asyncio.get_running_loop().create_future()
+            self._keyed_inflight[key] = keyed_fut
+            try:
+                # Durability contract: the accepted record is FSYNCED
+                # (append_json_line) before the ack line may reach the
+                # socket -- a key the client saw acknowledged survives
+                # router death.
+                await asyncio.to_thread(self._journal.record_accepted,
+                                        key,
+                                        {k: v for k, v in payload.items()
+                                         if k != "id"})
+            except BaseException:
+                self._keyed_inflight.pop(key, None)
+                keyed_fut.cancel()
+                raise
+            if ack is not None:
+                await ack(accepted_ack(req_id, key))
         self._accepted += 1
         self._inflight += 1
         _metrics.gauge("pycatkin_router_inflight",
@@ -584,8 +765,18 @@ class SweepRouter:
         try:
             resp = await self._dispatch_with_retries(payload, cls,
                                                      state, t0)
-        except ServeError:
+        except ServeError as exc:
             self._err_total += 1
+            if keyed_fut is not None:
+                self._resolve_key(key, keyed_fut,
+                                  error_response(req_id, exc.code,
+                                                 str(exc)))
+            raise
+        except BaseException:
+            if keyed_fut is not None:
+                self._resolve_key(key, keyed_fut,
+                                  error_response(req_id, E_INTERNAL,
+                                                 "dispatch aborted"))
             raise
         finally:
             self._inflight -= 1
@@ -605,7 +796,30 @@ class SweepRouter:
             self._err_total += 1
         self._finalize_audit(state, resp)
         resp = dict(resp, id=req_id)
+        if keyed_fut is not None:
+            if resp.get("ok"):
+                # Answered BEFORE the client can see the response; a
+                # prior record means a replay/resubmission race, and
+                # the two answers are audited like hedge losers.
+                prior = await asyncio.to_thread(
+                    self._journal.record_answered, key, resp)
+                if prior is not None:
+                    identical = (canonical_answer(prior)
+                                 == canonical_answer(resp))
+                    self._dup_identical += int(identical)
+                    self._dup_mismatched += int(not identical)
+                    if not identical:
+                        record_event("router",
+                                     action="duplicate-mismatch",
+                                     req_id=req_id)
+                    resp = dict(prior, id=req_id)
+            self._resolve_key(key, keyed_fut, resp)
         return resp
+
+    def _resolve_key(self, key: str, fut, resp: dict) -> None:
+        self._keyed_inflight.pop(key, None)
+        if not fut.done():
+            fut.set_result(resp)
 
     async def _dispatch_with_retries(self, payload: dict, cls: str,
                                      state: dict, t0: float) -> dict:
@@ -829,6 +1043,13 @@ class SweepRouter:
             "breakers": {str(i): br.state
                          for i, br in sorted(self._breakers.items())},
             "fleet": self.supervisor.stats(),
+            "durable": (None if self._journal is None else {
+                "journal": self._journal.stats(),
+                "replay": dict(self._replay_stats),
+                "duplicates_served": self._dup_served,
+                "coalesced": self._dup_coalesced,
+                "keyed_inflight": len(self._keyed_inflight),
+            }),
         }
 
     # -- TCP framing ---------------------------------------------------
@@ -838,6 +1059,19 @@ class SweepRouter:
         wlock = asyncio.Lock()
         tasks = set()
 
+        async def ack_line(obj: dict):
+            # The durability ack: _route_sweep only calls this after
+            # the accepted record is fsynced (fsync-before-ack). A
+            # dead client is not an error -- it will reconnect and
+            # resubmit by key.
+            data = (json.dumps(obj) + "\n").encode()
+            try:
+                async with wlock:
+                    writer.write(data)
+                    await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+
         async def one_line(line: bytes):
             try:
                 try:
@@ -846,7 +1080,7 @@ class SweepRouter:
                     resp = error_response(None, E_BAD_REQUEST,
                                           f"invalid JSON: {exc}")
                 else:
-                    resp = await self.handle(payload)
+                    resp = await self.handle(payload, ack=ack_line)
                 data = (json.dumps(resp) + "\n").encode()
                 async with wlock:
                     writer.write(data)
@@ -875,11 +1109,7 @@ class SweepRouter:
                 pass
 
 
-def _canonical(resp: dict) -> str:
-    """The client-visible ANSWER of a response, canonicalized for the
-    bitwise duplicate audit: the solver payload and quarantine verdict
-    (manifests/timing/pack metadata legitimately differ between
-    replicas; the answer must not)."""
-    return json.dumps({"result": resp.get("result"),
-                       "quarantine": resp.get("quarantine"),
-                       "lanes": resp.get("lanes")}, sort_keys=True)
+# The canonicalizer moved to serve/protocol.py (canonical_answer) so
+# the request journal can record it without importing the router; the
+# old name stays importable for the soak harness and tests.
+_canonical = canonical_answer

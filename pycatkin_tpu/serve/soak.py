@@ -283,11 +283,23 @@ def run_soak(out_path: Optional[str] = None, **kwargs) -> dict:
 CHAOS_SCHEMA = "pycatkin-serve-chaos/v1"
 
 
+def _free_port() -> int:
+    """Reserve an ephemeral port for the supervised router: it must
+    sit on a FIXED address across incarnations so reconnecting clients
+    find the rebooted process."""
+    import socket
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
                             lanes: int = 3, mechs: int = 4,
                             n_replicas: int = 3, kill: int = 2,
                             max_occupancy: int = 4, seed: int = 0,
                             with_pack: bool = True,
+                            router_crash: bool = False,
                             work_dir: Optional[str] = None,
                             verbose: bool = False) -> dict:
     """The serve-tier chaos drill (docs/failure_model.md):
@@ -312,6 +324,21 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
        and -- ``with_pack`` -- every replica's flushes compiled
        NOTHING (the pack-boot zero-compile proof), re-verified with
        one direct sweep per restarted replica.
+
+    ``router_crash=True`` additionally kills the FRONT ROUTER
+    (docs/serving.md "Durable requests"): the router runs as a
+    journal-backed subprocess under a ``FleetConfig(role="router")``
+    supervisor on a fixed port, every drill request carries an
+    ``idempotency_key``, and the fault plan SIGKILLs the router at the
+    ``router:front`` site mid-stream on top of the replica kills. The
+    reconnecting client resubmits its unanswered keyed requests; the
+    rebooted router replays its journal. The audit then ALSO fetches
+    every key's journaled answer (the ``result`` op) and requires it
+    bitwise identical to the baseline -- no acknowledged request may
+    be lost and no key may ever show two differing answers. The
+    dispatch-level faults (conn-reset / torn-line) move into the
+    router subprocess via ``PYCATKIN_FAULTS`` with its own ticket
+    directory (parent and child budgets must not share spec indices).
     """
     import sys
     import tempfile
@@ -348,7 +375,11 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
         work_dir = own_td.name
     pack_path = os.path.join(work_dir, "chaos_pack.tar.gz")
     tickets = os.path.join(work_dir, "fault_tickets")
-    supervisor = router = client = None
+    endpoints_path = os.path.join(work_dir, "endpoints.json")
+    journal_dir = os.path.join(work_dir, "journal")
+    router_tickets = os.path.join(work_dir, "fault_tickets_router")
+    supervisor = router = router_sup = client = None
+    router_events: list = []
     drill_ok = False
     try:
         # -- phase 1: undisturbed baseline + pack ----------------------
@@ -392,13 +423,48 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
                "--max-occupancy", str(max_occupancy)]
         supervisor = ReplicaSupervisor(FleetConfig(
             n_replicas=n_replicas, command=cmd, env=env,
-            aot_pack=pack_path if with_pack else None))
+            aot_pack=pack_path if with_pack else None,
+            endpoints_file=endpoints_path if router_crash else None))
         say(f"booting {n_replicas} replicas"
             f"{' from pack' if with_pack else ''}")
         await supervisor.start()
-        router = await SweepRouter(supervisor).start()
+        if router_crash:
+            # The router is a supervised subprocess on a FIXED port:
+            # clients must reconnect to the same address after its
+            # SIGKILL. Dispatch-level chaos rides in its environment
+            # (separate ticket dir -- spec indices must not collide
+            # with the parent plan's), and the journal segment cap is
+            # raised so drill answers never compact out of the dedup
+            # window mid-audit.
+            dispatch_specs = [
+                {"site": "router:dispatch:*", "kind": "conn-reset",
+                 "times": 1},
+                {"site": "router:dispatch:*", "kind": "torn-line",
+                 "times": 1}]
+            renv = {"PYCATKIN_ABI": "1",
+                    faults.ENV_VAR: json.dumps(
+                        {"specs": dispatch_specs,
+                         "state_dir": router_tickets}),
+                    "PYCATKIN_DURABLE_SEGMENT_BYTES": str(1 << 30)}
+            router_port = _free_port()
+            rcmd = [sys.executable, "-m", "pycatkin_tpu.serve",
+                    "--router", "--host", "127.0.0.1",
+                    "--port", str(router_port),
+                    "--fleet-file", endpoints_path,
+                    "--journal-dir", journal_dir]
+            router_sup = ReplicaSupervisor(FleetConfig(
+                role="router", command=rcmd, env=renv))
+            router_sup.add_listener(
+                lambda info: router_events.append(
+                    (time.monotonic(), dict(info))))
+            say(f"booting the journal-backed router subprocess "
+                f"on port {router_port}")
+            await router_sup.start()
+        else:
+            router = await SweepRouter(supervisor).start()
+            router_port = router.port
         client = await TcpSweepClient("127.0.0.1",
-                                      router.port).connect()
+                                      router_port).connect()
 
         # -- phase 3: stream + mid-soak chaos --------------------------
         results: list = [None] * n_requests
@@ -409,7 +475,9 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
             async with sem:
                 resp = await client.request(sweep_payload(
                     mech_dicts[mi], T, deadline_class=cls,
-                    req_id=f"q{i}"))
+                    req_id=f"q{i}",
+                    idempotency_key=(f"q{i}" if router_crash
+                                     else None)))
             results[i] = resp
             done_box["n"] += 1
 
@@ -422,17 +490,26 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
         specs = [{"site": f"router:replica:{i}",
                   "kind": "replica-crash", "times": 1}
                  for i in range(kill)]
-        specs += [{"site": "router:dispatch:*", "kind": "conn-reset",
-                   "times": 1},
-                  {"site": "router:dispatch:*", "kind": "torn-line",
-                   "times": 1}]
+        if router_crash:
+            # Dispatch faults live in the router subprocess's own
+            # plan; the parent enacts the kills, router included.
+            specs += [{"site": "router:front", "kind": "router-crash",
+                       "times": 1}]
+        else:
+            specs += [{"site": "router:dispatch:*",
+                       "kind": "conn-reset", "times": 1},
+                      {"site": "router:dispatch:*", "kind": "torn-line",
+                       "times": 1}]
         chaos = faults.FaultPlan(specs, state_dir=tickets)
-        say(f"chaos: SIGKILLing {kill} of {n_replicas} replicas "
+        say(f"chaos: SIGKILLing {kill} of {n_replicas} replicas"
+            f"{' + the front router' if router_crash else ''} "
             f"mid-soak")
         with faults.fault_scope(chaos):
             await drive
         kills_fired = [e for e in chaos.log
                        if e["kind"] == "replica-crash"]
+        router_kills = [e for e in chaos.log
+                        if e["kind"] == "router-crash"]
 
         # -- phase 4: audit --------------------------------------------
         say("waiting for killed replicas to reboot from the pack")
@@ -443,6 +520,60 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
                 and (r.incarnation < 2 or not r.routable)
                 for r in killed):
             await asyncio.sleep(0.1)
+
+        durable_audit = None
+        if router_crash:
+            say("waiting for the rebooted router + journal replay")
+            rrep = router_sup.replicas[0]
+            while time.monotonic() < reboot_deadline \
+                    and rrep.state != "abandoned" \
+                    and (rrep.incarnation < 2 or not rrep.routable):
+                await asyncio.sleep(0.1)
+            # Recovery wall: the supervisor's down event (router died)
+            # to the next up event (rebooted, registered, routable).
+            recovery_s = None
+            down_t = None
+            for t, ev in router_events:
+                if ev["event"] == "down" and down_t is None:
+                    down_t = t
+                elif ev["event"] == "up" and down_t is not None:
+                    recovery_s = t - down_t
+                    break
+            # Journal replay must have finished before the per-key
+            # audit (a key still in flight would fail the fetch).
+            replay = {}
+            durable = {}
+            while time.monotonic() < reboot_deadline:
+                st = await client.stats()
+                durable = ((st.get("stats") or {}).get("durable")
+                           if st.get("ok") else None) or {}
+                replay = durable.get("replay") or {}
+                if durable and not replay.get("active"):
+                    break
+                await asyncio.sleep(0.1)
+            # Every key's journaled answer, fetched over the wire,
+            # must be bitwise identical to the baseline: one key, one
+            # answer, forever.
+            result_bad = []
+            for i in range(n_requests):
+                rr = await client.fetch_result(f"q{i}")
+                if not rr.get("ok") or _canonical(rr) != baseline[i]:
+                    result_bad.append(
+                        {"key": f"q{i}",
+                         "error": rr.get("error"),
+                         "mismatch": bool(rr.get("ok"))})
+            durable_audit = {
+                "router_kills_fired": len(router_kills),
+                "router_incarnations": rrep.incarnation,
+                "router_recovery_s": recovery_s,
+                "journal_replay_s": replay.get("wall_s"),
+                "replay": replay,
+                "duplicates_served": durable.get("duplicates_served"),
+                "coalesced": durable.get("coalesced"),
+                "client_reconnects": client.reconnects,
+                "client_acks": client.acks,
+                "result_fetch_bad": result_bad,
+            }
 
         n_ok = sum(1 for r in results if r and r.get("ok"))
         mismatches = [i for i, r in enumerate(results)
@@ -482,9 +613,16 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
                          "flushes_with_compiles":
                              st.get("flushes_with_compiles")
                              if st else None})
-        rstats = router.stats()
+        if router_crash:
+            st = await client.stats()
+            rstats = (st.get("stats") or {}) if st.get("ok") else {}
+        else:
+            rstats = router.stats()
         await client.close()
-        await router.drain()
+        if router is not None:
+            await router.drain()
+        if router_sup is not None:
+            await router_sup.stop()
         await supervisor.stop()
         drill_ok = True
     finally:
@@ -493,6 +631,7 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
             # drill never strands replica subprocesses.
             for closer in (client and client.close,
                            router and router.stop,
+                           router_sup and router_sup.stop,
                            supervisor and supervisor.stop):
                 if closer is None:
                     continue
@@ -507,6 +646,8 @@ async def chaos_drill_async(n_requests: int = 24, bucket: int = 16,
     record = {
         "bench": "serve-chaos-drill", "schema": CHAOS_SCHEMA,
         "backend": backend, "with_pack": bool(with_pack),
+        "router_crash": bool(router_crash),
+        "durable": durable_audit,
         "n_requests": n_requests, "n_ok": n_ok,
         "n_failed": n_requests - n_ok,
         "bucket": bucket, "lanes": lanes, "mechs": mechs,
@@ -604,6 +745,313 @@ def check_chaos_record(record: dict) -> list:
     if record.get("with_pack") and router.get("zero_compile_violations"):
         problems.append(f"pack-booted replicas compiled during "
                         f"flushes: {router['zero_compile_violations']}")
+    if record.get("router_crash"):
+        durable = record.get("durable")
+        if not durable:
+            problems.append("router-crash drill produced no durable "
+                            "audit")
+            return problems
+        if not durable.get("router_kills_fired"):
+            problems.append("the router-crash fault never fired")
+        if (durable.get("router_incarnations") or 0) < 2:
+            problems.append(
+                f"the killed router never came back (incarnations="
+                f"{durable.get('router_incarnations')})")
+        if durable.get("result_fetch_bad"):
+            bad = durable["result_fetch_bad"]
+            problems.append(
+                f"{len(bad)} journaled answers missing or not "
+                f"bitwise identical to the baseline: {bad[:3]}")
+        replay = durable.get("replay") or {}
+        if replay.get("failed"):
+            problems.append(f"journal replay failed to re-answer "
+                            f"{replay['failed']} accepted requests")
+    return problems
+
+
+DURABLE_SCHEMA = "pycatkin-serve-durable-smoke/v1"
+
+
+def _write_json_file(path: str, obj) -> None:
+    with open(path, "w") as fh:
+        json.dump(obj, fh)
+
+
+def _append_bytes(path: str, data: bytes) -> None:
+    with open(path, "ab") as fh:
+        fh.write(data)
+
+
+async def durable_smoke_async(n_keys: int = 6, lanes: int = 2,
+                              work_dir: Optional[str] = None,
+                              verbose: bool = False) -> dict:
+    """The durable-serving smoke (``bench.py --smoke`` ``durable_ok``
+    gate): a miniature journal round-trip plus a router-kill replay,
+    JAX-free (stub replica) so it runs in seconds.
+
+    1. **journal round-trip** -- a tiny-segment
+       :class:`durable.RequestJournal` takes accepted/answered records
+       through rotation and compaction, gets its tail torn mid-record
+       (a kill mid-append), and must replay losing NOTHING but the
+       torn -- never acknowledged -- final line;
+    2. **router-kill replay** -- router A (journal-backed, fronting a
+       deterministic stub replica through a :class:`fleet.FileFleet`
+       endpoints file) answers ``n_keys`` keyed sweeps; two extra keys
+       are journaled accepted-but-unanswered, modeling a router killed
+       between fsynced ack and dispatch; router A stops WITHOUT
+       draining. Router B boots over the same journal: it must
+       re-dispatch exactly the pending backlog, serve a resubmitted
+       key bitwise from the journal, and answer a ``result`` fetch
+       identically.
+    """
+    import tempfile
+
+    from .client import TcpSweepClient, sweep_payload
+    from .durable import RequestJournal
+    from .fleet import FileFleet
+    from .protocol import PROTOCOL
+    from .router import RouterConfig, SweepRouter, _canonical
+
+    t_wall0 = time.monotonic()
+
+    def say(msg):
+        if verbose:
+            print(f"durable-smoke: {msg}", flush=True)
+
+    own_td = None
+    if work_dir is None:
+        own_td = tempfile.TemporaryDirectory(
+            prefix="pycatkin_durable_")
+        work_dir = own_td.name
+
+    # -- phase 1: journal round-trip ----------------------------------
+    n_rt = 8
+    jdir1 = os.path.join(work_dir, "roundtrip")
+    j = await asyncio.to_thread(RequestJournal, jdir1, 128)
+    for i in range(n_rt):
+        await asyncio.to_thread(j.record_accepted, f"rt{i}",
+                                {"op": "sweep", "n": i})
+        await asyncio.to_thread(
+            j.record_answered, f"rt{i}",
+            {"ok": True, "result": {"n": i}, "quarantine": [],
+             "lanes": 1, "id": f"rt{i}"})
+    await asyncio.to_thread(j.record_accepted, "rt-pending",
+                            {"op": "sweep", "n": -1})
+    st1 = j.stats()
+    # Tear the active segment's tail mid-record, as a SIGKILL between
+    # write and fsync would; the torn key was never acknowledged, so
+    # replay must drop it and keep everything before it.
+    torn_path = os.path.join(
+        jdir1, f"requests_{st1['active_segment']:05d}.jsonl")
+    await asyncio.to_thread(_append_bytes, torn_path,
+                            b'{"kind": "accepted", "key": "torn')
+    j2 = await asyncio.to_thread(RequestJournal, jdir1)
+    last = f"rt{n_rt - 1}"
+    roundtrip = {
+        "n": n_rt,
+        "rotations": st1["rotations"],
+        "compacted_segments": st1["compacted_segments"],
+        # Compaction deletes fully-answered sealed segments WITH their
+        # answered records -- that is the documented dedup-window
+        # bound -- so only answers in segments still on disk replay.
+        # The LAST answer always lands in a segment compaction never
+        # ran on, so its survival is the deterministic gate.
+        "answers_survived": sum(
+            1 for i in range(n_rt)
+            if (j2.answered_response(f"rt{i}") or {}).get("result")
+            == {"n": i}),
+        "last_answer_survived": (
+            (j2.answered_response(last) or {}).get("result")
+            == {"n": n_rt - 1}),
+        "pending_survived": [k for k, _ in j2.unanswered()],
+        "torn_key_leaked": j2.is_accepted("torn"),
+        "replayed_records": j2.stats()["replayed_records"],
+    }
+    say(f"roundtrip: {roundtrip}")
+
+    # -- phase 2: stub fleet + journal-backed router A ----------------
+    async def stub_handler(reader, writer):
+        # A wire-compatible replica whose answer is a pure function of
+        # the request's conditions: bitwise identity across dispatches
+        # and router incarnations is checkable with canonical_answer.
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    continue
+                if req.get("op") == "ping":
+                    resp = {"protocol": PROTOCOL, "id": req.get("id"),
+                            "ok": True, "pong": True}
+                else:
+                    T = list((req.get("conditions") or {})
+                             .get("T") or [])
+                    resp = {"protocol": PROTOCOL, "id": req.get("id"),
+                            "ok": True,
+                            "result": {"success": [True] * len(T),
+                                       "T": T},
+                            "quarantine": [], "lanes": len(T)}
+                writer.write((json.dumps(resp) + "\n").encode())
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    stub = await asyncio.start_server(stub_handler, "127.0.0.1", 0)
+    stub_port = stub.sockets[0].getsockname()[1]
+    endpoints_path = os.path.join(work_dir, "endpoints.json")
+    await asyncio.to_thread(_write_json_file, endpoints_path, {
+        "endpoints": [{"idx": 0, "incarnation": 1,
+                       "host": "127.0.0.1", "port": stub_port}]})
+    jdir = os.path.join(work_dir, "journal")
+    router_a = router_b = client = None
+    try:
+        router_a = await SweepRouter(
+            FileFleet(endpoints_path),
+            RouterConfig(port=0, journal_dir=jdir)).start()
+        client = await TcpSweepClient("127.0.0.1",
+                                      router_a.port).connect()
+        say(f"router A answering {n_keys} keyed sweeps")
+        baseline = {}
+        for i in range(n_keys):
+            resp = await client.request(sweep_payload(
+                {"stub": True}, [500.0 + i] * lanes,
+                req_id=f"s{i}", idempotency_key=f"k{i}"))
+            if not resp.get("ok"):
+                raise RuntimeError(f"stub sweep failed: {resp}")
+            baseline[f"k{i}"] = _canonical(resp)
+        acks_a = client.acks
+        await client.close()
+        client = None
+        # Model a router killed between fsynced ack and dispatch: the
+        # journal holds accepted records no answer ever followed.
+        for i in range(2):
+            await asyncio.to_thread(
+                router_a._journal.record_accepted, f"pending{i}",
+                {"op": "sweep", "mechanism": {"stub": True},
+                 "conditions": {"T": [600.0 + i] * lanes},
+                 "deadline_class": "standard",
+                 "idempotency_key": f"pending{i}"})
+        await router_a.stop()   # no drain: the "kill"
+        router_a = None
+
+        # -- phase 3: router B replays the journal --------------------
+        say("booting router B over the same journal")
+        t0 = time.monotonic()
+        router_b = await SweepRouter(
+            FileFleet(endpoints_path),
+            RouterConfig(port=0, journal_dir=jdir)).start()
+        recovery_s = time.monotonic() - t0
+        deadline = time.monotonic() + 30.0
+        replay = {}
+        while time.monotonic() < deadline:
+            replay = router_b.stats()["durable"]["replay"]
+            if not replay.get("active"):
+                break
+            await asyncio.sleep(0.01)
+        client = await TcpSweepClient("127.0.0.1",
+                                      router_b.port).connect()
+        # A duplicate of an answered key must come back bitwise from
+        # the journal, not from a fresh dispatch.
+        dup = await client.request(sweep_payload(
+            {"stub": True}, [500.0] * lanes, req_id="dup0",
+            idempotency_key="k0"))
+        fetch = await client.fetch_result("k1")
+        pend = await client.fetch_result("pending0")
+        bstats = router_b.stats()["durable"]
+        await client.close()
+        client = None
+        await router_b.drain()
+        router_b = None
+    finally:
+        for closer in (client and client.close,
+                       router_a and router_a.stop,
+                       router_b and router_b.stop):
+            if closer is None:
+                continue
+            try:
+                await closer()
+            except Exception:
+                pass
+        stub.close()
+        await stub.wait_closed()
+        if own_td is not None:
+            own_td.cleanup()
+
+    record = {
+        "bench": "serve-durable-smoke", "schema": DURABLE_SCHEMA,
+        "n_keys": n_keys, "lanes": lanes,
+        "roundtrip": roundtrip,
+        "replay": dict(replay, pending_expected=2,
+                       router_recovery_s=recovery_s),
+        "dup": {
+            "served": bstats.get("duplicates_served"),
+            "bitwise_ok": bool(dup.get("ok")
+                               and _canonical(dup) == baseline["k0"]),
+            "result_ok": bool(fetch.get("ok")
+                              and _canonical(fetch) == baseline["k1"]),
+            "replayed_pending_ok": bool(pend.get("ok")),
+            "acks": acks_a,
+        },
+        "journal": bstats.get("journal"),
+        "wall_s": time.monotonic() - t_wall0,
+    }
+    return record
+
+
+def run_durable_smoke(out_path: Optional[str] = None,
+                      **kwargs) -> dict:
+    """Synchronous entry for the durable smoke; optionally writes the
+    record (the ``make durable-check`` CI lane does)."""
+    record = asyncio.run(durable_smoke_async(**kwargs))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
+def check_durable_record(record: dict) -> list:
+    """Gate a durable-smoke record; returns failure strings (empty =
+    pass). ``make durable-check`` and ``bench.py --smoke`` share it."""
+    problems = []
+    rt = record.get("roundtrip") or {}
+    if not rt.get("rotations"):
+        problems.append("journal round-trip never rotated a segment")
+    if not rt.get("compacted_segments"):
+        problems.append("journal round-trip never compacted a "
+                        "fully-answered segment")
+    if not rt.get("last_answer_survived"):
+        problems.append("the newest journaled answer (whose segment "
+                        "was never compacted) did not survive replay")
+    if rt.get("pending_survived") != ["rt-pending"]:
+        problems.append(f"pending keys after replay: "
+                        f"{rt.get('pending_survived')} "
+                        f"(expected ['rt-pending'])")
+    if rt.get("torn_key_leaked"):
+        problems.append("a torn (never-acknowledged) final record "
+                        "leaked into the replayed journal")
+    replay = record.get("replay") or {}
+    if (replay.get("total") != replay.get("pending_expected")
+            or replay.get("failed")
+            or replay.get("done") != replay.get("total")):
+        problems.append(f"router-kill replay did not re-answer the "
+                        f"journal backlog: {replay}")
+    dup = record.get("dup") or {}
+    if not dup.get("bitwise_ok"):
+        problems.append("a duplicate keyed request was not answered "
+                        "bitwise from the journal")
+    if not dup.get("result_ok"):
+        problems.append("the result op did not return the journaled "
+                        "answer bitwise")
+    if not dup.get("replayed_pending_ok"):
+        problems.append("a replayed accepted-but-unanswered key has "
+                        "no fetchable answer")
+    if not dup.get("served"):
+        problems.append("the duplicates-served counter never moved")
+    if not dup.get("acks"):
+        problems.append("the client received no durability ack lines")
     return problems
 
 

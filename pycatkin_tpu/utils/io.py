@@ -391,11 +391,19 @@ def append_json_line(path: str, record: dict) -> None:
         os.fsync(fh.fileno())
 
 
-def read_json_lines(path: str) -> list:
-    """Read a ``.jsonl`` file written by :func:`append_json_line`,
-    tolerating a truncated FINAL line (a kill mid-append leaves at most
-    one partial record, which is dropped; a corrupt non-final line
-    still raises -- that is damage, not a crash artifact)."""
+def read_json_lines(path: str, *, tolerate_torn_tail: bool = True) -> list:
+    """Read a ``.jsonl`` file written by :func:`append_json_line`.
+
+    With ``tolerate_torn_tail=True`` (the crash-replay mode used by
+    both the chunk journal, robustness/journal.py, and the request
+    journal, serve/durable.py) a truncated FINAL line is dropped: a
+    kill mid-append leaves at most one partial record, which by the
+    fsync discipline of :func:`append_json_line` was never acknowledged
+    to anyone. With ``tolerate_torn_tail=False`` a torn tail raises
+    like any other corruption -- use it when the file is expected to be
+    complete (e.g. an atomically-published artifact). A corrupt
+    NON-final line always raises -- that is damage, not a crash
+    artifact."""
     records = []
     with open(path) as fh:
         lines = fh.read().splitlines()
@@ -405,7 +413,7 @@ def read_json_lines(path: str) -> list:
         try:
             records.append(json.loads(line))
         except json.JSONDecodeError:
-            if i == len(lines) - 1:
+            if i == len(lines) - 1 and tolerate_torn_tail:
                 break
             raise
     return records

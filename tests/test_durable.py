@@ -1,0 +1,568 @@
+"""Durable serving (docs/serving.md "Durable requests"): the
+write-ahead request journal, idempotent replay, and router-death
+recovery.
+
+No JAX anywhere: the journal is plain fsynced JSONL, and the router is
+exercised over stub TCP replicas exactly as in test_router.py. The
+acceptance surface, smallest-first: the journal's accept/answer ledger
+is idempotent and crash-replayable (rotation, compaction, torn final
+line); the shared torn-tail reader protects BOTH its callers (the
+chunk journal and the request journal); the accepted record is on disk
+before the ack closure runs (fsync-before-ack); a router booted over a
+journal left by a SIGKILL at each of the three crash points (pre-ack,
+post-ack pre-dispatch, post-answer pre-compaction) recovers exactly
+the right work; duplicate keys are answered bitwise from the journal;
+keyless requests are byte-identical with and without a journal; and
+the TCP client receives durability acks, fetches journaled results,
+and resubmits keyed requests across a severed connection.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from pycatkin_tpu.robustness.journal import SweepJournal
+from pycatkin_tpu.serve.client import TcpSweepClient, sweep_payload
+from pycatkin_tpu.serve.durable import RequestJournal
+from pycatkin_tpu.serve.protocol import (E_UNKNOWN_KEY,
+                                         canonical_answer)
+from pycatkin_tpu.serve.router import RouterConfig, SweepRouter
+from pycatkin_tpu.utils.io import read_json_lines
+
+pytestmark = pytest.mark.faults
+
+
+# -- stub replicas + fake supervisor (as in test_router.py) ------------
+
+
+class StubReplica:
+    """Wire-compatible replica: answers ``ping`` natively and routes
+    ``sweep`` through a swappable ``behavior(payload, writer)``."""
+
+    def __init__(self, behavior=None):
+        self.behavior = behavior or answer_sweep
+        self.up = True
+        self.port = None
+        self.sweeps_seen = 0
+        self._server = None
+        self._tasks = set()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        self._server.close()
+        await self._server.wait_closed()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+
+    async def _handle_sweep(self, payload, writer):
+        try:
+            resp = await self.behavior(payload, writer)
+            if resp is not None:
+                await _write(writer, resp)
+        except (ConnectionError, OSError):
+            pass
+
+    async def _on_conn(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    continue
+                if payload.get("op") == "ping":
+                    await _write(writer, {"ok": True, "pong": True,
+                                          "id": payload.get("id")})
+                    continue
+                self.sweeps_seen += 1
+                task = asyncio.ensure_future(
+                    self._handle_sweep(payload, writer))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except (ConnectionError, OSError):
+                pass
+
+
+async def _write(writer, obj):
+    writer.write((json.dumps(obj) + "\n").encode())
+    await writer.drain()
+
+
+async def answer_sweep(payload, writer):
+    """Deterministic answer derived from the request: duplicates of one
+    key are bit-identical, which is what every audit below leans on."""
+    return {"ok": True, "id": payload["id"],
+            "result": {"echo": payload.get("conditions")},
+            "quarantine": {"n_quarantined": 0}, "lanes": None}
+
+
+class FakeSupervisor:
+    def __init__(self, replicas):
+        self.replicas = list(replicas)
+        self._listeners = []
+
+    def add_listener(self, fn):
+        self._listeners.append(fn)
+
+    def endpoints(self):
+        return [{"idx": i, "incarnation": 1, "host": "127.0.0.1",
+                 "port": s.port}
+                for i, s in enumerate(self.replicas)
+                if s.up and s.port is not None]
+
+    def stats(self):
+        return {"n_replicas": len(self.replicas),
+                "up": sum(s.up for s in self.replicas), "replicas": []}
+
+
+def durable_config(journal_dir, **overrides):
+    kw = dict(max_inflight=16, breaker_fails=2,
+              breaker_cooldown_s=0.05, hedge_quantile=0.95,
+              hedge_min_s=0.02, retries=3, retry_base_delay_s=0.001,
+              retry_max_delay_s=0.01, connect_timeout_s=1.0,
+              probe_timeout_s=1.0, tick_s=0.005,
+              journal_dir=str(journal_dir) if journal_dir else None)
+    kw.update(overrides)
+    return RouterConfig(**kw)
+
+
+async def _router_over(replicas, journal_dir, listen=False,
+                       **cfg_overrides):
+    for r in replicas:
+        if r.port is None:
+            await r.start()
+    router = await SweepRouter(
+        FakeSupervisor(replicas),
+        durable_config(journal_dir, **cfg_overrides)).start(
+            listen=listen)
+    return router
+
+
+async def _wait_replay(router, timeout_s=10.0):
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while router.stats()["durable"]["replay"]["active"]:
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"replay never finished: {router.stats()['durable']}"
+        await asyncio.sleep(0.01)
+
+
+def _sweep(i=0, key=None):
+    return sweep_payload({"mech": "stub"}, [500.0 + i],
+                         deadline_class="standard", req_id=f"r{i}",
+                         idempotency_key=key)
+
+
+@pytest.fixture
+def short_budgets(monkeypatch):
+    monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_STANDARD", "5.0")
+    monkeypatch.setenv("PYCATKIN_SERVE_TIMEOUT_INTERACTIVE", "2.0")
+
+
+def _active_segment(jdir):
+    segs = sorted(f for f in os.listdir(jdir)
+                  if f.startswith("requests_"))
+    assert segs, f"no journal segments in {jdir}"
+    return os.path.join(jdir, segs[-1])
+
+
+def _tear_tail(path, torn=b'{"kind": "accepted", "key": "torn'):
+    with open(path, "ab") as fh:
+        fh.write(torn)
+
+
+# -- journal unit: idempotent ledger -----------------------------------
+
+
+def test_journal_idempotent_accept_and_answer(tmp_path):
+    j = RequestJournal(str(tmp_path / "j"))
+    assert j.record_accepted("k0", {"op": "sweep"}) is True
+    assert j.record_accepted("k0", {"op": "sweep"}) is False
+    assert j.is_accepted("k0")
+    assert j.unanswered() == [("k0", {"op": "sweep"})]
+    resp = {"ok": True, "id": "r0", "result": {"n": 1},
+            "quarantine": None, "lanes": None}
+    assert j.record_answered("k0", resp) is None
+    # A second answer returns the PRIOR stored response (id stripped)
+    # so the caller can audit bitwise identity.
+    prior = j.record_answered("k0", dict(resp, result={"n": 2}))
+    assert prior is not None and prior["result"] == {"n": 1}
+    assert "id" not in prior
+    assert j.answered_response("k0")["result"] == {"n": 1}
+    assert j.unanswered() == []
+    # Answering pins idempotency too: re-accepting an answered key is
+    # a no-op (the journal, not the caller, is the source of truth).
+    assert j.record_accepted("k0", {"op": "sweep"}) is False
+
+
+def test_journal_rotation_compaction_and_pinning(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = RequestJournal(jdir, segment_bytes=128)
+    j.record_accepted("pin", {"op": "sweep", "n": -1})
+    for i in range(8):
+        j.record_accepted(f"k{i}", {"op": "sweep", "n": i})
+        j.record_answered(f"k{i}", {"ok": True, "result": {"n": i},
+                                    "quarantine": None, "lanes": 1})
+    st = j.stats()
+    assert st["rotations"] > 0
+    assert st["compacted_segments"] > 0
+    assert st["pending"] == 1
+    # The unanswered key pins its segment: replay in a fresh process
+    # still knows about it, and the newest answer (which by
+    # construction lives in a segment compaction never ran on) is
+    # still servable. Older answers may legitimately have been
+    # compacted away -- that is the documented dedup-window bound.
+    j2 = RequestJournal(jdir, segment_bytes=128)
+    assert [k for k, _ in j2.unanswered()] == ["pin"]
+    assert j2.answered_response("k7")["result"] == {"n": 7}
+    assert j2.stats()["replayed_records"] > 0
+
+
+# -- torn-tail tolerance, per read_json_lines caller -------------------
+
+
+def test_request_journal_replay_tolerates_torn_tail(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = RequestJournal(jdir)
+    j.record_accepted("good", {"op": "sweep"})
+    j.record_answered("good", {"ok": True, "result": {"n": 1},
+                               "quarantine": None, "lanes": None})
+    seg = _active_segment(jdir)
+    _tear_tail(seg)
+    # Strict mode sees the damage; the journal's replay mode drops
+    # exactly the torn final record (which was never acked to anyone).
+    with pytest.raises(json.JSONDecodeError):
+        read_json_lines(seg, tolerate_torn_tail=False)
+    j2 = RequestJournal(jdir)
+    assert not j2.is_accepted("torn")
+    assert j2.answered_response("good")["result"] == {"n": 1}
+    # The next append truncates the torn tail first, so the file heals
+    # instead of accreting corruption.
+    assert j2.record_accepted("after", {"op": "sweep"}) is True
+    for rec in read_json_lines(seg, tolerate_torn_tail=False):
+        assert rec["key"] != "torn"
+
+
+def test_chunk_journal_resume_tolerates_torn_tail(tmp_path):
+    jdir = str(tmp_path / "chunks")
+    j = SweepJournal(jdir, fingerprint="fp", n_lanes=4, chunk=2)
+    j.record_chunk(0, 0, 2, "done")
+    _tear_tail(j.manifest_path, b'{"kind": "chunk", "chunk_id": 1')
+    j2 = SweepJournal(jdir, fingerprint="fp", resume=True)
+    recs = j2.chunk_records()
+    assert [r["chunk_id"] for r in recs] == [0]
+    # Resume can keep appending over the healed tail.
+    j2.record_chunk(1, 2, 4, "done")
+    assert len(read_json_lines(j2.manifest_path,
+                               tolerate_torn_tail=False)) >= 3
+
+
+# -- fsync-before-ack ordering -----------------------------------------
+
+
+def test_accepted_record_is_on_disk_before_ack(tmp_path, short_budgets):
+    jdir = str(tmp_path / "j")
+
+    async def scenario():
+        stub = StubReplica()
+        router = await _router_over([stub], jdir)
+        seen_at_ack = []
+
+        async def ack(obj):
+            # The durability contract: when the ack closure runs, the
+            # accepted record must already be fsynced to the journal.
+            on_disk = read_json_lines(_active_segment(jdir),
+                                      tolerate_torn_tail=True)
+            seen_at_ack.append((dict(obj), [
+                (r["kind"], r["key"]) for r in on_disk]))
+
+        try:
+            resp = await router.handle(_sweep(0, key="dk0"), ack=ack)
+            assert resp["ok"], resp
+        finally:
+            await router.stop()
+            await stub.stop()
+        assert len(seen_at_ack) == 1
+        obj, on_disk = seen_at_ack[0]
+        assert obj["accepted"] is True and obj["key"] == "dk0"
+        assert ("accepted", "dk0") in on_disk
+        assert ("answered", "dk0") not in on_disk
+        # And the answer was journaled before the client saw it.
+        final = read_json_lines(_active_segment(jdir),
+                                tolerate_torn_tail=True)
+        assert ("answered", "dk0") in [(r["kind"], r["key"])
+                                       for r in final]
+    asyncio.run(scenario())
+
+
+# -- the three crash points --------------------------------------------
+
+
+def test_crash_pre_ack_leaves_no_accepted_work(tmp_path, short_budgets):
+    # SIGKILL mid-append, BEFORE the ack: the journal holds one torn
+    # record. Replay must treat the key as never accepted (the client
+    # was never promised anything) and a resubmission runs fresh.
+    jdir = tmp_path / "j"
+    jdir.mkdir()
+    (jdir / "requests_00000.jsonl").write_bytes(
+        b'{"kind": "accepted", "key": "c0", "pay')
+
+    async def scenario():
+        stub = StubReplica()
+        router = await _router_over([stub], str(jdir))
+        try:
+            st = router.stats()["durable"]
+            assert st["replay"]["total"] == 0
+            assert st["journal"]["pending"] == 0
+            resp = await router.handle(_sweep(0, key="c0"))
+            assert resp["ok"]
+            assert stub.sweeps_seen == 1
+        finally:
+            await router.stop()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+def test_crash_post_ack_replays_and_answers(tmp_path, short_budgets):
+    # SIGKILL after the ack but before dispatch: the accepted record
+    # is durable, no answer exists. The rebooted router must
+    # re-dispatch it unprompted and journal the answer.
+    jdir = str(tmp_path / "j")
+    payload = {k: v for k, v in _sweep(0, key="c1").items()
+               if k != "id"}
+    RequestJournal(jdir).record_accepted("c1", payload)
+
+    async def scenario():
+        stub = StubReplica()
+        router = await _router_over([stub], jdir)
+        try:
+            assert router.stats()["durable"]["replay"]["total"] == 1
+            await _wait_replay(router)
+            replay = router.stats()["durable"]["replay"]
+            assert replay["done"] == 1 and replay["failed"] == 0
+            assert replay["wall_s"] is not None
+            assert stub.sweeps_seen == 1
+            # The answer is fetchable by key and a duplicate submit is
+            # served from the journal WITHOUT touching the fleet.
+            fetched = await router.handle({"op": "result", "key": "c1",
+                                           "id": "f0"})
+            assert fetched["ok"] and fetched["id"] == "f0"
+            dup = await router.handle(_sweep(9, key="c1"))
+            assert canonical_answer(dup) == canonical_answer(fetched)
+            assert stub.sweeps_seen == 1
+            assert router.stats()["durable"]["duplicates_served"] == 1
+        finally:
+            await router.stop()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+def test_crash_post_answer_serves_bitwise(tmp_path, short_budgets):
+    # SIGKILL after the answer was journaled (but before any
+    # compaction): the rebooted router has nothing to replay and must
+    # serve the journaled answer bitwise to a duplicate key.
+    jdir = str(tmp_path / "j")
+    j = RequestJournal(jdir)
+    j.record_accepted("c2", {k: v for k, v in
+                             _sweep(0, key="c2").items() if k != "id"})
+    answer = {"ok": True, "id": "orig", "result": {"echo": {"T": [7.0]}},
+              "quarantine": {"n_quarantined": 0}, "lanes": None}
+    j.record_answered("c2", answer)
+
+    async def scenario():
+        stub = StubReplica()
+        router = await _router_over([stub], jdir)
+        try:
+            assert router.stats()["durable"]["replay"]["total"] == 0
+            dup = await router.handle(_sweep(5, key="c2"))
+            assert dup["ok"] and dup["id"] == "r5"
+            assert canonical_answer(dup) == canonical_answer(answer)
+            assert stub.sweeps_seen == 0
+        finally:
+            await router.stop()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+# -- live duplicate handling -------------------------------------------
+
+
+def test_duplicate_key_bitwise_and_coalescing(tmp_path, short_budgets):
+    async def slowish(payload, writer):
+        await asyncio.sleep(0.1)
+        return await answer_sweep(payload, writer)
+
+    async def scenario():
+        stub = StubReplica(behavior=slowish)
+        router = await _router_over([stub], str(tmp_path / "j"))
+        try:
+            # Two concurrent submissions of one key coalesce onto one
+            # dispatch; a later resubmission is served from the
+            # journal. All three answers are bitwise identical.
+            a, b = await asyncio.gather(
+                router.handle(_sweep(0, key="dup")),
+                router.handle(_sweep(1, key="dup")))
+            late = await router.handle(_sweep(2, key="dup"))
+            assert a["ok"] and b["ok"] and late["ok"]
+            assert len({canonical_answer(r)
+                        for r in (a, b, late)}) == 1
+            assert (a["id"], b["id"], late["id"]) == ("r0", "r1", "r2")
+            assert stub.sweeps_seen == 1
+            st = router.stats()["durable"]
+            assert st["coalesced"] >= 1
+            assert st["duplicates_served"] >= 1
+            assert router.stats()["duplicates"]["mismatched"] == 0
+        finally:
+            await router.stop()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+def test_keyless_requests_are_byte_identical(tmp_path, short_budgets):
+    # The pinned regression of the durable extension: a keyless sweep
+    # through a journal-backed router is byte-identical to one through
+    # a journal-less router, no ack line, nothing journaled.
+    async def scenario():
+        stub_a, stub_b = StubReplica(), StubReplica()
+        plain = await _router_over([stub_a], None)
+        durable = await _router_over([stub_b],
+                                     str(tmp_path / "j"))
+        acks = []
+
+        async def ack(obj):
+            acks.append(obj)
+
+        try:
+            ra = await plain.handle(_sweep(3), ack=ack)
+            rb = await durable.handle(_sweep(3), ack=ack)
+            assert json.dumps(ra, sort_keys=True) == \
+                json.dumps(rb, sort_keys=True)
+            assert acks == []
+            st = durable.stats()["durable"]["journal"]
+            assert st["pending"] == 0 and st["answered"] == 0
+        finally:
+            await plain.stop()
+            await durable.stop()
+            await stub_a.stop()
+            await stub_b.stop()
+    asyncio.run(scenario())
+
+
+# -- TCP client: acks, result fetch, keyed resubmission ----------------
+
+
+def test_tcp_client_acks_and_result_fetch(tmp_path, short_budgets):
+    async def scenario():
+        stub = StubReplica()
+        router = await _router_over([stub], str(tmp_path / "j"),
+                                    listen=True)
+        cli = await TcpSweepClient("127.0.0.1",
+                                   router.port).connect()
+        try:
+            resp = await cli.request(_sweep(0, key="tk0"), timeout=5.0)
+            assert resp["ok"] and resp["id"] == "r0"
+            assert cli.acks == 1
+            fetched = await cli.fetch_result("tk0")
+            assert fetched["ok"]
+            assert canonical_answer(fetched) == canonical_answer(resp)
+            missing = await cli.fetch_result("nope")
+            assert missing["ok"] is False
+            assert missing["error"]["code"] == E_UNKNOWN_KEY
+        finally:
+            await cli.close()
+            await router.stop()
+            await stub.stop()
+    asyncio.run(scenario())
+
+
+def test_tcp_client_resubmits_keyed_across_severed_conn(short_budgets):
+    # A server that severs the first connection mid-request, then
+    # answers normally: a KEYED request must survive the cut -- the
+    # client reconnects, resubmits verbatim, and resolves ok.
+    class FlakyServer:
+        def __init__(self):
+            self.conns = 0
+            self.port = None
+            self._server = None
+
+        async def start(self):
+            self._server = await asyncio.start_server(
+                self._on, "127.0.0.1", 0)
+            self.port = self._server.sockets[0].getsockname()[1]
+            return self
+
+        async def stop(self):
+            self._server.close()
+            await self._server.wait_closed()
+
+        async def _on(self, reader, writer):
+            self.conns += 1
+            sever = self.conns == 1
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    req = json.loads(line)
+                    if sever:
+                        writer.transport.abort()
+                        return
+                    await _write(writer, {
+                        "ok": True, "id": req.get("id"),
+                        "result": {"n": 1}, "quarantine": None,
+                        "lanes": None})
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+
+    async def scenario():
+        srv = await FlakyServer().start()
+        cli = await TcpSweepClient(
+            "127.0.0.1", srv.port,
+            reconnect_base_delay_s=0.01).connect()
+        try:
+            resp = await cli.request(_sweep(0, key="rk0"),
+                                     timeout=10.0)
+            assert resp["ok"], resp
+            assert resp["id"] == "r0"
+            assert cli.reconnects >= 1
+            assert srv.conns >= 2
+        finally:
+            await cli.close()
+            await srv.stop()
+        from pycatkin_tpu.obs import metrics
+        assert "pycatkin_serve_reconnects_total" in \
+            metrics.snapshot()["counters"]
+    asyncio.run(scenario())
+
+
+# -- perfwatch tracks the durable metrics ------------------------------
+
+
+def test_history_extracts_durable_metrics():
+    from pycatkin_tpu.obs.history import TRACKED_METRICS, \
+        extract_metrics
+    assert TRACKED_METRICS["router_recovery_s"] == "lower"
+    assert TRACKED_METRICS["journal_replay_s"] == "lower"
+    record = {"bench": "serve-chaos-drill",
+              "durable": {"router_recovery_s": 0.8,
+                          "journal_replay_s": 0.05}}
+    got = extract_metrics(record)
+    assert got["router_recovery_s"] == 0.8
+    assert got["journal_replay_s"] == 0.05
+    assert "router_recovery_s" not in extract_metrics({"bench": "x"})

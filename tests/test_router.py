@@ -28,8 +28,9 @@ from pycatkin_tpu.robustness import faults
 from pycatkin_tpu.serve import client as serve_client
 from pycatkin_tpu.serve.client import TcpSweepClient, sweep_payload
 from pycatkin_tpu.serve.fleet import FleetConfig, ReplicaSupervisor
-from pycatkin_tpu.serve.protocol import (E_DRAINING, E_INTERNAL,
-                                         E_OVERLOADED, E_TIMEOUT,
+from pycatkin_tpu.serve.protocol import (E_CONN_LOST, E_DRAINING,
+                                         E_INTERNAL, E_OVERLOADED,
+                                         E_TIMEOUT,
                                          request_timeout_for)
 from pycatkin_tpu.serve.router import (CircuitBreaker, RouterConfig,
                                        SweepRouter, _canonical)
@@ -289,10 +290,13 @@ def test_client_counts_torn_final_line(monkeypatch):
         try:
             resp = await cli.request(_sweep(0), timeout=5.0)
             # The torn line is counted and the dropped connection
-            # fails the pending request instead of hanging it.
+            # fails the keyless pending request with a structured
+            # connection-loss error instead of hanging it.
             assert cli.torn_lines == 1
             assert resp["ok"] is False
-            assert resp["error"]["code"] == E_INTERNAL
+            assert resp["error"]["code"] == E_CONN_LOST
+            assert resp["error"]["idempotency_key"] is False
+            assert str(stub.port) in resp["error"]["peer"]
         finally:
             await cli.close()
             await stub.stop()

@@ -26,7 +26,18 @@ replicas mid-soak plus one torn line and one connection reset, and
 hard-fail unless ZERO requests are lost, every answer is bitwise
 identical to an undisturbed same-grid run, the duplicate-suppression
 audit is clean, and the restarted replicas serve at a 100%
-zero-compile rate straight from the AOT pack.
+zero-compile rate straight from the AOT pack. By default the drill
+ALSO SIGKILLs the journal-backed front router mid-stream
+(docs/serving.md "Durable requests"): every request carries an
+idempotency key, the rebooted router replays its write-ahead journal,
+and the gate additionally requires zero acknowledged requests lost
+and every journaled answer bitwise identical to the baseline
+(``--no-router-crash`` reverts to the replica-only drill).
+
+``--durable`` is the durable-serving smoke (``make durable-check``):
+a JAX-free journal round-trip (rotation, compaction, torn-tail
+replay) plus a router-kill replay over stub replicas, gated by
+``serve/soak.py check_durable_record``.
 """
 
 from __future__ import annotations
@@ -140,26 +151,55 @@ def _cmd_chaos(args) -> int:
         lanes=args.lanes, mechs=args.mechs_per_bucket,
         n_replicas=args.replicas, kill=args.kill,
         max_occupancy=args.max_occupancy, seed=args.seed,
-        with_pack=not args.no_pack, verbose=args.verbose)
+        with_pack=not args.no_pack,
+        router_crash=not args.no_router_crash, verbose=args.verbose)
     router = record.get("router") or {}
     print(json.dumps(record if args.full_json else {
         "bench": record["bench"], "backend": record["backend"],
         "n_requests": record["n_requests"], "n_ok": record["n_ok"],
         "kills_fired": record["kills_fired"],
         "incarnations": record["incarnations"],
-        "router": router, "wall_s": record["wall_s"]}, indent=2))
+        "router": router, "durable": record.get("durable"),
+        "wall_s": record["wall_s"]}, indent=2))
     problems = check_chaos_record(record)
     for p in problems:
         print(f"chaos: GATE FAIL -- {p}", file=sys.stderr)
     if problems:
         return 1
+    durable = record.get("durable") or {}
+    extra = ""
+    if record.get("router_crash"):
+        extra = (f", router killed and recovered in "
+                 f"{durable.get('router_recovery_s')}s (journal "
+                 f"replay {durable.get('journal_replay_s')}s)")
     print(f"chaos: OK -- {record['n_ok']}/{record['n_requests']} "
           f"answered bit-identically while "
           f"{record['kills_fired']}/{record['n_replicas']} replicas "
           f"were killed and rebooted from the pack "
           f"(availability={router.get('availability')}, "
-          f"failover_p99_s={router.get('failover_p99_s')})",
+          f"failover_p99_s={router.get('failover_p99_s')}){extra}",
           file=sys.stderr)
+    return 0
+
+
+def _cmd_durable(args) -> int:
+    """Durable-serving smoke; see module docstring and serve/soak.py."""
+    from pycatkin_tpu.serve.soak import check_durable_record, \
+        run_durable_smoke
+
+    record = run_durable_smoke(out_path=args.json,
+                               verbose=args.verbose)
+    print(json.dumps(record, indent=2))
+    problems = check_durable_record(record)
+    for p in problems:
+        print(f"durable: GATE FAIL -- {p}", file=sys.stderr)
+    if problems:
+        return 1
+    replay = record.get("replay") or {}
+    print(f"durable: OK -- journal round-trip survived rotation + "
+          f"compaction + a torn tail; router-kill replay re-answered "
+          f"{replay.get('done')}/{replay.get('total')} pending keys "
+          f"in {replay.get('wall_s'):.3f}s", file=sys.stderr)
     return 0
 
 
@@ -178,6 +218,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-pack", action="store_true",
                     help="chaos drill without the AOT boot pack "
                          "(skips the zero-compile gate)")
+    ap.add_argument("--no-router-crash", action="store_true",
+                    help="chaos drill without killing the front "
+                         "router (replica kills only)")
+    ap.add_argument("--durable", action="store_true",
+                    help="durable-serving smoke: journal round-trip "
+                         "+ router-kill replay over stub replicas")
     ap.add_argument("--n", type=int, default=1000)
     ap.add_argument("--buckets", default="16,32,128")
     ap.add_argument("--lanes", type=int, default=4)
@@ -205,6 +251,8 @@ def main(argv=None) -> int:
                          "(pack-booted server)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.durable:
+        return _cmd_durable(args)
     if args.chaos:
         args.n = args.n if args.n != 1000 else 24
         args.mechs_per_bucket = (args.mechs_per_bucket
