@@ -53,6 +53,13 @@
 # durable-serving smoke (docs/serving.md "Durable requests"): a
 # write-ahead journal round-trip through rotation, compaction and a
 # torn tail, plus a router-kill replay over stub replicas.
+# `kernels-check` is the Pallas direction-kernel lane
+# (docs/perf_pallas_linalg.md): the kernel equivalence/dispatch/key
+# suite re-run with the kernel tier FORCED on the interpret-mode CPU
+# path (PYCATKIN_LINALG_KERNEL=pallas + PYCATKIN_LINALG_INTERPRET=1),
+# then a quick --linalg microbench proving every
+# (bucket x tier x kernel) cell runs and reports per-bucket MFU
+# against the measured matmul ceiling.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
@@ -60,7 +67,7 @@ PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 .PHONY: test test-faults test-validate test-sharded test-san test-all \
 	lint lint-faults lint-syncs lint-baseline bench-smoke \
 	aot-pack-selftest obs-check perfwatch chaos serve-check \
-	router-check durable-check
+	router-check durable-check kernels-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -106,6 +113,13 @@ lint-baseline:
 
 bench-smoke:
 	env JAX_PLATFORMS=cpu python bench.py --smoke
+
+kernels-check:
+	env JAX_PLATFORMS=cpu PYCATKIN_LINALG_KERNEL=pallas \
+		PYCATKIN_LINALG_INTERPRET=1 python -m pytest \
+		tests/test_pallas_linalg.py -q -m 'not slow' \
+		-p no:cacheprovider
+	env JAX_PLATFORMS=cpu python bench.py --linalg --quick
 
 aot-pack-selftest:
 	env JAX_PLATFORMS=cpu python tools/aot_pack.py selftest

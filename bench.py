@@ -744,7 +744,9 @@ def smoke_main():
     exactly 1), a prewarmed program missing its cost-ledger row, a
     sweep output missing its per-lane telemetry bundle, a breach of
     the packed multi-tenant contracts (zero marginal compiles, one
-    sync, bitwise-vs-solo; ``packed_ok``), or any pcsan runtime
+    sync, bitwise-vs-solo; ``packed_ok``), a direction-kernel breach
+    (interpret-mode Pallas LU vs XLA LU bit-compare + forced-kernel
+    sweep verdict identity; ``kernels_ok``), or any pcsan runtime
     tripwire firing on the sanitizer-guarded re-run (``san_ok``) -- the
     cheap
     end-to-end canary that the correctness gates and the pipelined
@@ -867,6 +869,87 @@ def smoke_main():
             else:
                 os.environ[precision.TIER_ENV] = tier_prev
         tier_ok = tier_err is None
+
+        # Direction-kernel gate (ISSUE-18): the interpret-mode Pallas
+        # LU bit-compared against the XLA-op LU on an 8x8 lane batch
+        # at two ABI bucket shapes, then the same 8x8 sweep re-run
+        # with the kernel tier forced (PYCATKIN_LINALG_KERNEL=pallas
+        # + PYCATKIN_LINALG_INTERPRET=1) -- verdict masks must
+        # reproduce the ambient-kernel sweep bitwise and the solved
+        # states stay inside the documented envelope
+        # (docs/perf_pallas_linalg.md).
+        kernels_err = None
+        kern_prev = os.environ.get(precision.KERNEL_ENV)
+        interp_prev = os.environ.get(precision.INTERPRET_ENV)
+        try:
+            import jax.numpy as _jnp
+
+            from pycatkin_tpu.ops import linalg as _linalg
+            from pycatkin_tpu.ops import pallas_linalg as _plk
+            krng = np.random.default_rng(18)
+            for nk in (16, 32):
+                Ak = _jnp.asarray(
+                    krng.standard_normal((GRID_N * GRID_N, nk, nk)))
+                Ak = Ak + 4 * _jnp.eye(nk)
+                bk = _jnp.asarray(
+                    krng.standard_normal((GRID_N * GRID_N, nk)))
+                import jax as _jx
+                # Lane-for-lane the kernel is a bitwise twin of the
+                # XLA LU (same arithmetic, same order) -- pin that on
+                # one lane.
+                xp1 = _plk.factor_solve(Ak[0], bk[0])
+                xx1 = _linalg.lu_solve(*_linalg.lu_factor(Ak[0]),
+                                       bk[0])
+                if (np.asarray(xp1).tobytes()
+                        != np.asarray(xx1).tobytes()):
+                    kernels_err = (f"interpret-mode kernel not "
+                                   f"bit-identical to the XLA LU at "
+                                   f"n={nk}")
+                    break
+                # Under vmap XLA batches its contractions (reduction
+                # reorder), so the lane batch carries a tiny measured
+                # envelope instead (docs/perf_pallas_linalg.md).
+                xp = _jx.vmap(_plk.factor_solve)(Ak, bk)
+                xx = _jx.vmap(lambda a, r: _linalg.lu_solve(
+                    *_linalg.lu_factor(a), r))(Ak, bk)
+                if not np.allclose(np.asarray(xp), np.asarray(xx),
+                                   rtol=1e-10, atol=1e-14):
+                    kernels_err = (f"vmapped kernel left the XLA-LU "
+                                   f"equivalence envelope at n={nk}")
+                    break
+            if kernels_err is None:
+                os.environ[precision.KERNEL_ENV] = "pallas"
+                os.environ[precision.INTERPRET_ENV] = "1"
+                outk = sweep_steady_state(spec, conds, tof_mask=mask,
+                                          check_stability=True)
+                for k in ("success", "stable", "quarantined"):
+                    a, b = np.asarray(out[k]), np.asarray(outk[k])
+                    if a.tobytes() != b.tobytes():
+                        kernels_err = (f"verdict {k!r} differs "
+                                       f"between the xla and pallas "
+                                       f"kernel tiers")
+                        break
+            if kernels_err is None:
+                ya, yk = np.asarray(out["y"]), np.asarray(outk["y"])
+                ok = np.asarray(out["success"], dtype=bool)
+                # Cross-trajectory envelope (independently converged
+                # Newton runs; see docs/perf_pallas_linalg.md).
+                if not np.allclose(ya[ok], yk[ok],
+                                   rtol=1e-5, atol=1e-12):
+                    kernels_err = ("solved states left the kernel "
+                                   "equivalence envelope")
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            kernels_err = str(e)
+        finally:
+            if kern_prev is None:
+                os.environ.pop(precision.KERNEL_ENV, None)
+            else:
+                os.environ[precision.KERNEL_ENV] = kern_prev
+            if interp_prev is None:
+                os.environ.pop(precision.INTERPRET_ENV, None)
+            else:
+                os.environ[precision.INTERPRET_ENV] = interp_prev
+        kernels_ok = kernels_err is None
 
         # Packed-batch gate (ISSUE-12): K same-bucket mechanisms as one
         # dispatch each, with the zero-marginal-compile, one-sync and
@@ -1118,6 +1201,8 @@ def smoke_main():
                                    else None),
         "abi_marginal_compiled": abi_marginal_compiled,
         "abi_zero_compile_ok": abi_zero_compile_ok,
+        "kernels_ok": kernels_ok,
+        "kernels_error": kernels_err,
         "packed": packed,
         "packed_ok": packed_ok,
         "serve": serve,
@@ -1193,6 +1278,10 @@ def smoke_main():
     if not tier_ok:
         log(f"bench-smoke: FAIL -- precision-tier gate: {tier_err}")
         return 1
+    if not kernels_ok:
+        log(f"bench-smoke: FAIL -- direction-kernel gate: "
+            f"{kernels_err}")
+        return 1
     if not packed_ok:
         detail = (packed.get("error")
                   or "; ".join(packed.get("failures") or ())
@@ -1226,6 +1315,146 @@ def smoke_main():
     log(f"bench-smoke: OK -- {budget.count} host sync(s) on the sweep, "
         f"{n_ok}/{n} converged, {int(n_prog)} program(s) prewarmed "
         f"(full bench layout {planned}/{PREWARM_PROGRAM_BUDGET})")
+    return 0
+
+
+def _linalg_cells(buckets, tiers, lanes_for, iters, rng):
+    """The (bucket, tier, kernel) microbench grid for linalg_main:
+    batched factorize+solve wall per cell, via the SAME entry points
+    the sweep hot path dispatches through (linalg.select_solver's two
+    kernel tiers called directly, no env games)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pycatkin_tpu import precision
+    from pycatkin_tpu.ops import linalg as _linalg
+    from pycatkin_tpu.ops import pallas_linalg as _plk
+
+    cells = []
+    for n in buckets:
+        lanes = lanes_for(n)
+        for tier in tiers:
+            dtype = precision.bulk_dtype(tier)
+            # Well-conditioned batch: random + dominant diagonal (the
+            # microbench measures kernel throughput, not rescue-ladder
+            # conditioning behavior -- tests own the hard numerics).
+            A = jnp.asarray(rng.standard_normal((lanes, n, n)),
+                            dtype=dtype) + 4 * jnp.eye(n, dtype=dtype)
+            b = jnp.asarray(rng.standard_normal((lanes, n)),
+                            dtype=dtype)
+            # 2/3 n^3 factorization + 2 n^2 substitution useful flops
+            # per lane-solve (the classical LU count; shared numerator
+            # for both kernels so the cells are comparable).
+            cell_flops = lanes * (2.0 * n ** 3 / 3.0 + 2.0 * n ** 2)
+            for kernel, fn in (
+                    ("xla", lambda a, r: _linalg.lu_solve(
+                        *_linalg.lu_factor(a), r)),
+                    ("pallas", _plk.factor_solve)):
+                run = jax.jit(jax.vmap(fn))
+                try:
+                    x = run(A, b)
+                    jax.block_until_ready(x)
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        x = run(A, b)
+                    jax.block_until_ready(x)
+                    wall = time.perf_counter() - t0
+                except Exception as e:  # noqa: BLE001 - cell reports
+                    cells.append({"bucket": n, "tier": tier,
+                                  "kernel": kernel, "error": str(e)})
+                    continue
+                cells.append({
+                    "bucket": n, "tier": tier, "kernel": kernel,
+                    "lanes": lanes, "iters": iters,
+                    "wall_s": round(wall, 4),
+                    "flops_per_solve": cell_flops,
+                    "achieved_flops_per_s": cell_flops * iters / wall,
+                })
+    return cells
+
+
+def linalg_main(argv):
+    """``bench.py --linalg``: the direction-kernel microbench lane
+    (docs/perf_pallas_linalg.md). Batched dense factorize+solve wall,
+    achieved FLOP/s and MFU per (ABI bucket, precision tier, kernel)
+    cell -- the Pallas VMEM-resident LU against the XLA-op LU it
+    tiers behind -- printed as exactly one JSON line.
+
+    MFU here divides by a MEASURED per-backend ceiling: a dense-matmul
+    roofline probe run at each tier's bulk dtype in-process, not a
+    datasheet number and not the scaled-by-16 estimate the f32 roofline
+    note used to carry. ``--quick`` shrinks lanes/iters for CI. The
+    ``linalg`` sub-object (``mfu_<bucket>``) feeds the perfwatch
+    history (``linalg_mfu_<bucket>`` tracked metrics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pycatkin_tpu import precision
+
+    quick = "--quick" in argv
+    iters = 2 if quick else int(os.environ.get("BENCH_LINALG_ITERS",
+                                               "5"))
+    rng = np.random.default_rng(18)
+    from pycatkin_tpu.ops.pallas_linalg import PALLAS_BUCKETS
+
+    def lanes_for(n):
+        base = 4096 if not quick else 512
+        return max(2, min(256, base // n))
+
+    # Measured compute ceiling per tier: chained square matmuls at the
+    # tier's bulk dtype (the arithmetic class the solver actually
+    # runs), timed on THIS backend. The real denominator the MFU
+    # numbers below are honest against.
+    peaks = {}
+    m = 512 if quick else 1024
+    for tier in precision.TIERS:
+        dtype = precision.bulk_dtype(tier)
+        a = jnp.asarray(rng.standard_normal((m, m)), dtype=dtype)
+        mm = jax.jit(lambda x, y: x @ y)
+        out = jax.block_until_ready(mm(a, a))
+        reps = 4 if quick else 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = mm(a, out)
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+        peaks[tier] = 2.0 * m ** 3 * reps / wall
+
+    cells = _linalg_cells(PALLAS_BUCKETS, precision.TIERS, lanes_for,
+                          iters, rng)
+    for c in cells:
+        peak = peaks.get(c.get("tier"))
+        if peak and c.get("achieved_flops_per_s"):
+            c["mfu"] = round(c["achieved_flops_per_s"] / peak, 6)
+
+    # Per-bucket headline MFU for perfwatch: the Pallas kernel cell at
+    # f64 (the tier every sweep verdict is certified at). Absent cells
+    # (a kernel that failed to run) simply leave the metric out.
+    linalg_summary = {}
+    for c in cells:
+        if (c.get("kernel") == "pallas" and c.get("tier") == "f64"
+                and c.get("mfu") is not None):
+            linalg_summary[f"mfu_{c['bucket']}"] = c["mfu"]
+
+    result = {
+        "metric": "linalg microbench",
+        "backend": jax.devices()[0].platform,
+        "unit": "mfu vs measured matmul ceiling",
+        "interpret": jax.default_backend() != "tpu",
+        "peak_measured_flops_per_s": {t: round(p, 1)
+                                      for t, p in peaks.items()},
+        "cells": cells,
+        "linalg": linalg_summary,
+    }
+    print(json.dumps(result))
+    for c in cells:
+        if "error" in c:
+            log(f"bench-linalg: FAIL -- cell {c['bucket']}/{c['tier']}"
+                f"/{c['kernel']}: {c['error']}")
+            return 1
+    log("bench-linalg: OK -- " + ", ".join(
+        f"n={b}: {linalg_summary.get(f'mfu_{b}', float('nan')):.3f}"
+        for b in PALLAS_BUCKETS))
     return 0
 
 
@@ -1371,12 +1600,15 @@ def _prior_round_value():
 
 if __name__ == "__main__":
     # No arguments: the historical timing benchmark, exactly one JSON
-    # line. --smoke is the CI canary; any other argument switches to
-    # the journaled chunked mode. --trace DIR composes with every mode
-    # (stripped here so the routing below never sees it).
+    # line. --smoke is the CI canary; --linalg the direction-kernel
+    # microbench lane; any other argument switches to the journaled
+    # chunked mode. --trace DIR composes with every mode (stripped
+    # here so the routing below never sees it).
     TRACE_DIR = _strip_trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         sys.exit(smoke_main())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--linalg":
+        sys.exit(linalg_main(sys.argv[1:]))
     elif len(sys.argv) > 1:
         journal_main(sys.argv[1:])
     else:

@@ -25,6 +25,7 @@ import numpy as np
 import pandas as pd
 
 from .. import engine
+from .. import precision as _precision
 from ..parallel.batch import (batch_steady_state, batch_transient,
                               stack_conditions)
 from ..robustness.ladder import run_chunk_with_ladder
@@ -47,10 +48,15 @@ def _net_rates_program(spec):
     return jax.jit(jax.vmap(net_rates))
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=128)
-def _drc_program(spec, tof_terms, drc_mode, eps, sopts):
+def _drc_program(spec, tof_terms, drc_mode, eps, sopts,
+                 kernel="xla"):
     """Batched DRC returning (xi [lanes, n_r], ok [lanes]): ok=False
-    lanes had an unconverged (perturbed) solve and carry unreliable xi."""
+    lanes had an unconverged (perturbed) solve and carry unreliable xi.
+    ``kernel`` is a cache key only (precision.kernel_keyed): the
+    perturbed steady solves bake the direction-kernel choice in at
+    trace time."""
     if drc_mode == "fd":
         # opts deliberately not forwarded: drc_fd's default tightened
         # tolerances are required for a meaningful difference quotient.

@@ -463,15 +463,22 @@ def transient(spec: ModelSpec, cond: Conditions, save_ts,
     return ys.at[-1].set(y_fin), ok
 
 
+@_precision.kernel_keyed
 @_lru_cache(maxsize=16)
-def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
+def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions,
+                             kernel: str = "xla"):
+    # ``kernel`` is a cache key only (precision.kernel_keyed): the
+    # implicit ODE stages embed make_msolve direction solves, which
+    # bake the PYCATKIN_LINALG_KERNEL choice in at trace time.
     def run(cond, state, part):
         return transient_state(spec, cond, state, part, opts)
     return jax.jit(run)
 
 
+@_precision.kernel_keyed
 @_lru_cache(maxsize=16)
-def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions):
+def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions,
+                              kernel: str = "xla"):
     def run(cond, y_last, ok):
         return transient_finish(spec, cond, y_last, ok, sopts=sopts)
     return jax.jit(run)

@@ -243,8 +243,15 @@ class CostLedger:
         tot_flops = tot_wall = tot_peak_budget = 0.0
         by_tier: dict = {}
         for row in rows.values():
-            tier = _precision.tier_of_tag(str(row.get("kind", "")))
+            kind = str(row.get("kind", ""))
+            tier = _precision.tier_of_tag(kind)
             row["tier"] = tier
+            # Direction-kernel tier (":kpl" tag): lets perfwatch score
+            # a Pallas-kernel program against its XLA twin row-by-row.
+            # Only stamped on tagged rows so pre-kernel snapshots stay
+            # byte-identical.
+            if ":kpl" in kind:
+                row["kernel"] = _precision.kernel_of_tag(kind)
             peak_f = peak_flops_for_tier(peak, tier)
             wall = row.get("blocked_wall_s", 0.0)
             n = row.get("dispatches", 0)

@@ -44,6 +44,15 @@ TRACKED_METRICS = {
     # smoke's durable gate; pulled from the "durable" sub-object).
     "router_recovery_s": "lower",     # SIGKILL-to-routable router wall
     "journal_replay_s": "lower",      # boot replay of the WAL backlog
+    # Linalg microbench (bench.py --linalg; pulled from the record's
+    # "linalg" sub-object): per-ABI-bucket MFU of the batched
+    # factorize+solve against the MEASURED per-backend matmul ceiling
+    # (docs/perf_pallas_linalg.md), so a direction-kernel regression
+    # is caught bucket-by-bucket.
+    "linalg_mfu_16": "higher",
+    "linalg_mfu_32": "higher",
+    "linalg_mfu_128": "higher",
+    "linalg_mfu_512": "higher",
 }
 
 # A regression must clear BOTH gates: beyond ``mad_k`` median absolute
@@ -85,8 +94,11 @@ def extract_metrics(record: dict) -> dict:
     metrics fall back to the ``serve`` sub-object a serve-soak record
     (or the smoke gate) nests them under; ``router_availability`` /
     ``failover_p99_s`` likewise fall back to the ``router``
-    sub-object of a chaos-drill record, and ``router_recovery_s`` /
-    ``journal_replay_s`` to its ``durable`` sub-object."""
+    sub-object of a chaos-drill record, ``router_recovery_s`` /
+    ``journal_replay_s`` to its ``durable`` sub-object, and
+    ``linalg_mfu_<bucket>`` to the ``linalg`` sub-object a
+    ``bench.py --linalg`` record nests them under (as
+    ``mfu_<bucket>``)."""
     rec = _unwrap(record)
     serve = rec.get("serve") if isinstance(rec.get("serve"),
                                            dict) else {}
@@ -94,6 +106,8 @@ def extract_metrics(record: dict) -> dict:
                                              dict) else {}
     durable = rec.get("durable") if isinstance(rec.get("durable"),
                                                dict) else {}
+    linalg = rec.get("linalg") if isinstance(rec.get("linalg"),
+                                             dict) else {}
     out = {}
     for key in TRACKED_METRICS:
         v = rec.get(key)
@@ -109,6 +123,8 @@ def extract_metrics(record: dict) -> dict:
         if v is None and key in ("router_recovery_s",
                                  "journal_replay_s"):
             v = durable.get(key)
+        if v is None and key.startswith("linalg_"):
+            v = linalg.get(key[len("linalg_"):])
         try:
             f = float(v)
         except (TypeError, ValueError):

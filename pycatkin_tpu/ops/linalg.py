@@ -16,9 +16,13 @@ broadcast multiply-add over a [n, n] tile.
 
 from __future__ import annotations
 
+from typing import Callable, NamedTuple
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .. import precision as _precision
 
 
 # Sequential steps per device dispatch in the very-large-n LU kernels.
@@ -238,18 +242,93 @@ UNROLL_MAX = 48
 _UNROLL_MAX = UNROLL_MAX  # backward-compat alias
 
 
+class SolverChoice(NamedTuple):
+    """One resolved solve-path choice from :func:`select_solver`.
+
+    ``path`` names the kernel family (``"pallas"`` | ``"gauss"`` |
+    ``"lu"``), ``make_solve(M)`` factors once and returns a reusable
+    solve closure (the chord-reuse contract), ``solve(A, b)`` is the
+    one-shot direct solve. ``tier`` / ``kernel`` record what the
+    selection resolved to (introspection; dtypes always flow from the
+    operands themselves)."""
+    path: str
+    n: int
+    tier: str
+    kernel: str
+    make_solve: Callable
+    solve: Callable
+
+
+def _make_inv_solve(M: jnp.ndarray):
+    """Small-n factor-once path: explicit Gauss-Jordan inverse, solves
+    collapse to matvecs (beats sequential substitution on TPU)."""
+    Minv = inv(M)
+    return lambda r: Minv @ r
+
+
+def _make_lu_solve(M: jnp.ndarray):
+    """Large-n factor-once path: sequential LU + triangular solves."""
+    lu, piv = lu_factor(M)
+    return lambda r: lu_solve(lu, piv, r)
+
+
+def _lu_solve_once(A: jnp.ndarray, b: jnp.ndarray):
+    return lu_solve(*lu_factor(A), b)
+
+
+def select_solver(n: int, tier: str = None,
+                  backend: str = None) -> SolverChoice:
+    """THE dispatch seam for dense direction solves: every solve-path
+    decision (Newton direction kernel, chord reuse, tier-2 Jacobian
+    solves) resolves through here, so there is exactly one place the
+    small-n/large-n policy and the Pallas/XLA kernel tier
+    (``PYCATKIN_LINALG_KERNEL``, docs/perf_pallas_linalg.md) live.
+
+    - Pallas kernel resolved AND ``n`` is a static ABI bucket size:
+      the VMEM-resident batched LU of
+      :mod:`pycatkin_tpu.ops.pallas_linalg` (fused factorize+solve;
+      ``make_solve`` reuses the factorization per chord step).
+    - else ``n <= UNROLL_MAX``: trace-time-unrolled Gauss-Jordan
+      (:func:`gauss_solve` / explicit :func:`inv` for reuse).
+    - else: the chunk-unrolled sequential :func:`lu_factor` /
+      :func:`lu_solve`.
+
+    With the kernel resolved to ``xla`` (the default off-TPU) the
+    selection reproduces the historical :func:`solve` /
+    :func:`make_msolve` behavior exactly -- byte-identical programs.
+    """
+    n = int(n)
+    if tier is None:
+        tier = _precision.active_tier()
+    kernel = _precision.linalg_kernel(backend)
+    if kernel == "pallas" and _pallas().supported(n):
+        plk = _pallas()
+        return SolverChoice("pallas", n, tier, kernel,
+                            plk.make_msolve, plk.factor_solve)
+    if n <= UNROLL_MAX:
+        return SolverChoice("gauss", n, tier, kernel,
+                            _make_inv_solve, gauss_solve)
+    return SolverChoice("lu", n, tier, kernel,
+                        _make_lu_solve, _lu_solve_once)
+
+
+def _pallas():
+    """Lazy import of the Pallas kernel module (keeps plain-XLA users
+    off the jax.experimental.pallas import path entirely)."""
+    from . import pallas_linalg
+    return pallas_linalg
+
+
 def make_msolve(M: jnp.ndarray):
     """Factor M once, return a solve closure reusable for several RHS.
 
-    Encapsulates the small-n/large-n dispatch policy: small systems get
-    an explicit Gauss-Jordan inverse (subsequent solves are matvecs),
-    large ones an LU factorization with triangular solves.
+    Thin shim over :func:`select_solver` (the single dispatch seam):
+    small systems get an explicit Gauss-Jordan inverse (subsequent
+    solves are matvecs), large ones an LU factorization with
+    triangular solves, bucket-shaped systems the Pallas kernel when
+    that tier is resolved.
     """
-    if M.shape[-1] <= UNROLL_MAX:
-        Minv = inv(M)
-        return lambda r: Minv @ r
-    lu, piv = lu_factor(M)
-    return lambda r: lu_solve(lu, piv, r)
+    return select_solver(M.shape[-1]).make_solve(M)
 
 
 def _pivot_swap(M, k, idx):
@@ -306,11 +385,9 @@ def inv(A: jnp.ndarray) -> jnp.ndarray:
 
 
 def solve(A: jnp.ndarray, b: jnp.ndarray):
-    """Solve A x = b (square, dense) for any dtype on any backend."""
-    if A.shape[-1] <= _UNROLL_MAX:
-        return gauss_solve(A, b)
-    LU, perm = lu_factor(A)
-    return lu_solve(LU, perm, b)
+    """Solve A x = b (square, dense) for any dtype on any backend.
+    Thin shim over :func:`select_solver` (the single dispatch seam)."""
+    return select_solver(A.shape[-1]).solve(A, b)
 
 
 def make_mixed_solve(A: jnp.ndarray):

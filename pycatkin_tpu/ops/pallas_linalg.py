@@ -1,0 +1,252 @@
+"""Pallas batched dense LU kernels for the Newton direction solve.
+
+The sweep hot path bottoms out in repeated small dense factorize/solve
+(one per Newton/PTC/LM iteration per lane). The XLA-op kernels in
+:mod:`pycatkin_tpu.ops.linalg` express that as ``lax.fori_loop`` bodies
+over full ``[n, n]`` tiles, which leaves the schedule to XLA: every
+elimination step round-trips the tile through whatever layout the
+fusion picked. These kernels instead pin the WHOLE per-lane
+factorization resident in VMEM for the duration of the loop: one
+kernel invocation factors one lane's matrix start-to-finish, and the
+lane axis batches over it (``jax.vmap``'s ``pallas_call`` batching
+rule lifts the lane axis into the kernel grid, one grid program per
+lane -- which is exactly the "one grid program per lane-tile" shape on
+TPU).
+
+Supported shapes are the static ABI species buckets
+(:data:`PALLAS_BUCKETS`): bucket-padded systems are what the hot loop
+actually solves under ``PYCATKIN_ABI=1``, the padded ghost lanes carry
+``x' = -x`` so the Jacobian is ``blkdiag(J, -I)`` and factors
+harmlessly (the ``-1`` diagonal pivots are exact), and a static n is
+what lets the kernel claim its VMEM up front. Everything else falls
+back to the XLA path at the dispatch seam
+(:func:`pycatkin_tpu.ops.linalg.select_solver`).
+
+Numerics mirror ``ops/linalg`` step for step -- partial pivoting with
+first-max row selection, the same elimination update, the same
+triangular recurrences -- but expressed gather/scatter-free: row
+swaps, row/column extraction and the permutation apply are all
+``where``-selects driven by 2D-``broadcasted_iota`` one-hot masks
+(exact selects, never ``0 * x`` products, so Inf/NaN quarantine lanes
+stay merely non-finite instead of poisoning neighbours). A singular
+lane divides by a zero pivot and yields non-finite output, exactly the
+quarantine semantics the XLA path has.
+
+On anything that is not a TPU the kernels run under Pallas
+``interpret=True`` (the kernel body lowers to ordinary XLA HLO under
+jit -- full speed, no hardware dependency), which is what the
+equivalence corpus in ``tests/test_pallas_linalg.py`` and the
+``bench.py --smoke`` ``kernels_ok`` gate pin against the XLA path.
+Tier selection, program-key tagging (``:kpl``) and the auto/fallback
+policy live in :mod:`pycatkin_tpu.precision`
+(``PYCATKIN_LINALG_KERNEL``); docs/perf_pallas_linalg.md is the full
+contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..lint.hotpath import hotpath
+
+#: The static ABI species buckets the kernels are built for
+#: (frontend/abi bucket table). The dispatch seam only routes an n
+#: through Pallas when it is exactly one of these.
+PALLAS_BUCKETS = (16, 32, 128, 512)
+
+
+def supported(n: int) -> bool:
+    """Whether the Pallas kernels serve systems of size ``n`` (static
+    ABI bucket sizes only -- everything else stays on the XLA path)."""
+    return int(n) in PALLAS_BUCKETS
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode: on for every non-TPU backend, so the
+    kernels are runnable (and CI-provable) anywhere; compiled Mosaic
+    only on real TPU hardware."""
+    return jax.default_backend() != "tpu"
+
+
+def _row_ids(n: int):
+    """``[0..n)`` as int32 via 2D iota (TPU requires >= 2D iota)."""
+    return lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]
+
+
+def _pick_row(M, oh):
+    """Row ``i`` of ``M`` where ``oh`` one-hots ``i`` -- a masked
+    select + sum (n-1 exact zeros plus the row), never a gather."""
+    return jnp.sum(jnp.where(oh[:, None], M, jnp.zeros((), M.dtype)),
+                   axis=0)
+
+
+def _pick_col(M, oh):
+    """Column ``j`` of ``M`` where ``oh`` one-hots ``j``."""
+    return jnp.sum(jnp.where(oh[None, :], M, jnp.zeros((), M.dtype)),
+                   axis=1)
+
+
+def _factor_body(A, perm, rid):
+    """The full pivoted elimination loop over a resident ``[n, n]``
+    value. Mirrors ``linalg._lu_step`` arithmetic exactly (same
+    multiplier and update expressions), with one-hot selects in place
+    of the dynamic row/column indexing."""
+    n = A.shape[-1]
+    zero = jnp.zeros((), A.dtype)
+
+    def step(k, carry):
+        A, perm = carry
+        oh_col = rid == k
+        col = jnp.abs(_pick_col(A, oh_col))
+        col = jnp.where(rid < k, -jnp.inf, col)
+        # First row attaining the column max == argmax (linalg uses
+        # jnp.argmax; identical for the finite pivots that matter).
+        p = jnp.min(jnp.where(col == jnp.max(col), rid, n))
+        p = p.astype(jnp.int32)
+        oh_k = rid == k
+        oh_p = rid == p
+        row_k = _pick_row(A, oh_k)
+        row_p = _pick_row(A, oh_p)
+        A = jnp.where(oh_k[:, None], row_p[None, :],
+                      jnp.where(oh_p[:, None], row_k[None, :], A))
+        pk = jnp.sum(jnp.where(oh_k, perm, 0)).astype(jnp.int32)
+        pp = jnp.sum(jnp.where(oh_p, perm, 0)).astype(jnp.int32)
+        perm = jnp.where(oh_k, pp,
+                         jnp.where(oh_p, pk, perm)).astype(jnp.int32)
+        # Eliminate below the pivot; store multipliers in column k.
+        colk = _pick_col(A, oh_col)
+        pivot = jnp.sum(jnp.where(oh_k, colk, zero))
+        factors = jnp.where(rid > k, colk / pivot, zero)
+        rowk = _pick_row(A, oh_k)
+        upd = jnp.where(rid >= k, rowk, zero)
+        A = A - factors[:, None] * upd[None, :]
+        colk_new = _pick_col(A, oh_col)
+        col_store = jnp.where(rid > k, factors, colk_new)
+        A = jnp.where(oh_col[None, :], col_store[:, None], A)
+        return A, perm
+
+    return lax.fori_loop(0, n - 1, step, (A, perm))
+
+
+def _permute_rhs(b, perm, rid):
+    """``b[perm]`` as an exact one-hot select (no gather): output row
+    r takes input row ``perm[r]`` wherever the [n, n] match mask hits."""
+    sel = perm[:, None] == rid[None, :]
+    zero = jnp.zeros((), b.dtype)
+    return jnp.sum(jnp.where(sel[:, :, None], b[None, :, :], zero),
+                   axis=1)
+
+
+def _solve_body(LU, y, rid):
+    """Forward/backward substitution over resident values, mirroring
+    ``linalg.lu_solve``'s masked row-dot recurrences term for term
+    (same contraction, so per-step results agree bitwise)."""
+    n = LU.shape[-1]
+    zero = jnp.zeros((), LU.dtype)
+
+    def fwd(i, y):
+        oh = rid == i
+        row = _pick_row(LU, oh)
+        s = jnp.where(rid < i, row, zero) @ y
+        yi = _pick_row(y, oh)
+        return jnp.where(oh[:, None], (yi - s)[None, :], y)
+
+    def bwd(j, x):
+        i = n - 1 - j
+        oh = rid == i
+        row = _pick_row(LU, oh)
+        s = jnp.where(rid > i, row, zero) @ x
+        dii = jnp.sum(jnp.where(oh, row, zero))
+        xi = _pick_row(x, oh)
+        return jnp.where(oh[:, None], ((xi - s) / dii)[None, :], x)
+
+    y = lax.fori_loop(0, n, fwd, y)
+    return lax.fori_loop(0, n, bwd, y)
+
+
+def _lu_kernel(a_ref, lu_ref, perm_ref):
+    """Factor one resident lane: A -> (LU, perm), all in VMEM."""
+    A = a_ref[...]
+    rid = _row_ids(A.shape[-1])
+    LU, perm = _factor_body(A, rid, rid)
+    lu_ref[...] = LU
+    perm_ref[...] = perm
+
+
+def _lu_solve_kernel(lu_ref, perm_ref, b_ref, x_ref):
+    """Solve one resident lane given a prior factorization."""
+    LU = lu_ref[...]
+    rid = _row_ids(LU.shape[-1])
+    y = _permute_rhs(b_ref[...].astype(LU.dtype), perm_ref[...], rid)
+    x_ref[...] = _solve_body(LU, y, rid)
+
+
+def _factor_solve_kernel(a_ref, b_ref, x_ref):
+    """Fused factorize-then-solve: one kernel, the matrix never leaves
+    VMEM between the factorization and the substitution passes."""
+    A = a_ref[...]
+    rid = _row_ids(A.shape[-1])
+    LU, perm = _factor_body(A, rid, rid)
+    y = _permute_rhs(b_ref[...].astype(LU.dtype), perm, rid)
+    x_ref[...] = _solve_body(LU, y, rid)
+
+
+def _as_mat(b):
+    """RHS to ``[n, k]`` (the kernels' fixed rank), remembering whether
+    to squeeze back -- the same [n] / [n, k] convention linalg uses."""
+    return (b[:, None], True) if b.ndim == 1 else (b, False)
+
+
+@hotpath
+def lu_factor(A: jnp.ndarray):
+    """Pallas LU factorization with partial pivoting of one ``[n, n]``
+    system (``vmap`` batches lanes into the kernel grid). Returns
+    ``(LU, perm)`` in :func:`pycatkin_tpu.ops.linalg.lu_factor`'s
+    convention; ``perm`` is int32."""
+    n = A.shape[-1]
+    return pl.pallas_call(
+        _lu_kernel,
+        out_shape=(jax.ShapeDtypeStruct((n, n), A.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.int32)),
+        interpret=_interpret(),
+    )(A)
+
+
+@hotpath
+def lu_solve(LU: jnp.ndarray, perm: jnp.ndarray, b: jnp.ndarray):
+    """Pallas triangular solve for :func:`lu_factor` output.
+    ``b``: [n] or [n, k]."""
+    n = LU.shape[-1]
+    bm, squeeze = _as_mat(b)
+    x = pl.pallas_call(
+        _lu_solve_kernel,
+        out_shape=jax.ShapeDtypeStruct(bm.shape, LU.dtype),
+        interpret=_interpret(),
+    )(LU, perm.astype(jnp.int32), bm)
+    return x[:, 0] if squeeze else x
+
+
+@hotpath
+def factor_solve(A: jnp.ndarray, b: jnp.ndarray):
+    """Fused factorize-then-solve of ``A x = b`` in one kernel
+    (matches ``linalg.solve``'s call contract at bucket shapes)."""
+    bm, squeeze = _as_mat(b)
+    x = pl.pallas_call(
+        _factor_solve_kernel,
+        out_shape=jax.ShapeDtypeStruct(bm.shape, A.dtype),
+        interpret=_interpret(),
+    )(A, bm)
+    return x[:, 0] if squeeze else x
+
+
+@hotpath
+def make_msolve(M: jnp.ndarray):
+    """Factor ``M`` once, return a solve closure reusable for several
+    RHS -- :func:`pycatkin_tpu.ops.linalg.make_msolve`'s contract, so
+    the chord-reuse Newton path re-uses the Pallas factorization per
+    chord step."""
+    LU, perm = lu_factor(M)
+    return lambda r: lu_solve(LU, perm, r)

@@ -120,9 +120,13 @@ def _steady_kind(opts: SolverOptions, strategy: str,
     prewarm and the hot path MUST derive it identically (shapes ride in
     the key separately). ``tier`` tags non-default precision tiers so
     f32-bulk and f64 programs never share a registry/AOT entry; the
-    f64 tag is empty, keeping every pre-tier key byte-identical."""
+    f64 tag is empty, keeping every pre-tier key byte-identical. The
+    direction-kernel tag (``:kpl``, resolved from
+    PYCATKIN_LINALG_KERNEL at call time) rides after the tier tag for
+    the same reason: Pallas-kernel and XLA programs never share an
+    entry, and the xla tag is empty."""
     return (f"steady:{strategy}:{opts!r}{_precision.tier_tag(tier)}"
-            f"{_sharding_tag(sharding)}")
+            f"{_precision.kernel_tag()}{_sharding_tag(sharding)}")
 
 
 def _pacing_key(opts: SolverOptions) -> SolverOptions:
@@ -137,7 +141,10 @@ def _pacing_key(opts: SolverOptions) -> SolverOptions:
 
 
 def _rescue_kind(opts: SolverOptions, sharding=None) -> str:
-    return f"rescue:{_pacing_key(opts)!r}{_sharding_tag(sharding)}"
+    # Rescue always runs f64 (no tier tag), but its Newton ladder
+    # embeds direction solves, so the kernel tag applies.
+    return (f"rescue:{_pacing_key(opts)!r}{_precision.kernel_tag()}"
+            f"{_sharding_tag(sharding)}")
 
 
 def _screen_kind(pos_tol: float, backend: str) -> str:
@@ -156,7 +163,8 @@ def _fused_kind(opts: SolverOptions, pos_tol: float, backend: str,
     byte-identical; the cost ledger keys its roofline on this tag)."""
     return (f"fused:{opts!r}:{pos_tol!r}:{backend}"
             f":s{int(check_stability)}t{int(has_tof)}"
-            f"{_precision.tier_tag(tier)}{_sharding_tag(sharding)}")
+            f"{_precision.tier_tag(tier)}{_precision.kernel_tag()}"
+            f"{_sharding_tag(sharding)}")
 
 
 def _fused_enabled() -> bool:
@@ -259,14 +267,17 @@ def _donate_argnums(argnums):
     return () if jax.default_backend() == "cpu" else tuple(argnums)
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
 def _steady_program(spec: ModelSpec, opts: SolverOptions,
                     out_sharding=None, strategy: str = "ptc",
-                    tier: str = "f64"):
+                    tier: str = "f64", kernel: str = "xla"):
     # ``tier`` is an explicit cache-key parameter (never read from the
     # environment inside the builder): flipping PYCATKIN_PRECISION_TIER
     # at runtime must select a DIFFERENT cached program, not mutate a
-    # stale one.
+    # stale one. ``kernel`` plays the same cache-key role for
+    # PYCATKIN_LINALG_KERNEL (filled by the kernel_keyed wrapper; the
+    # trace bakes select_solver's choice in).
     if isinstance(spec, _abi.AbiProgramSpec):
         # ABI form: the mechanism rides in as the leading traced operand
         # pytree instead of being constant-folded, so every mechanism in
@@ -298,9 +309,10 @@ def _steady_program(spec: ModelSpec, opts: SolverOptions,
     return jax.jit(fn, **kw)
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
 def _rescue_program(spec: ModelSpec, pacing: SolverOptions,
-                    out_sharding=None):
+                    out_sharding=None, kernel: str = "xla"):
     """ONE strategy-parameterized rescue program per (spec, verdict
     tolerances, bucket shape): the r05 zoo compiled four separate
     programs per bucket (polish / full PTC / LM / unseeded PTC). Here
@@ -362,8 +374,12 @@ def _rescue_program(spec: ModelSpec, pacing: SolverOptions,
     return jax.jit(program, **kw)
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
-def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
+def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions,
+                             kernel: str = "xla"):
+    # ``kernel`` is a cache key only (kernel_keyed): the implicit ODE
+    # stages embed make_msolve direction solves.
     if isinstance(spec, _abi.AbiProgramSpec):
         def program(ops, conds, state, part):
             tspec = spec.bind(ops)
@@ -379,8 +395,10 @@ def _transient_chunk_program(spec: ModelSpec, opts: ODEOptions):
     return jax.jit(jax.vmap(run_one, in_axes=(0, 0, None)))
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
-def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions):
+def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions,
+                              kernel: str = "xla"):
     if isinstance(spec, _abi.AbiProgramSpec):
         def program(ops, conds, y_last, ok):
             tspec = spec.bind(ops)
@@ -846,12 +864,14 @@ def _abi_fused_body(spec: "_abi.AbiProgramSpec", opts: SolverOptions,
     return program
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
 def _packed_fused_sweep_program(spec: "_abi.AbiProgramSpec",
                                 opts: SolverOptions, pos_tol: float,
                                 backend: str, has_tof: bool,
                                 check_stability: bool,
-                                tier: str = "f64"):
+                                tier: str = "f64",
+                                kernel: str = "xla"):
     """The multi-tenant fused sweep: :func:`_abi_fused_body` vmapped
     over a new leading *tenant* axis, so K same-bucket mechanisms'
     sweeps are ONE device dispatch producing the solo output tuple with
@@ -871,11 +891,12 @@ def _packed_fused_sweep_program(spec: "_abi.AbiProgramSpec",
                    donate_argnums=_donate_argnums((2,)))
 
 
+@_precision.kernel_keyed
 @lru_cache(maxsize=16)
 def _fused_sweep_program(spec: ModelSpec, opts: SolverOptions,
                          pos_tol: float, backend: str, has_tof: bool,
                          check_stability: bool, out_sharding=None,
-                         tier: str = "f64"):
+                         tier: str = "f64", kernel: str = "xla"):
     """The whole clean sweep as ONE device program: batched steady
     solve, per-lane NaN quarantine, tier-0 stability certificate
     (Gershgorin + deflated-Lyapunov -- byte-identical math to

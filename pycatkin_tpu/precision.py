@@ -30,6 +30,7 @@ names); ``jax.numpy`` loads lazily inside the cast helpers.
 
 from __future__ import annotations
 
+import functools
 import os
 
 TIER_ENV = "PYCATKIN_PRECISION_TIER"
@@ -82,6 +83,100 @@ def tier_of_tag(kind: str) -> str:
     roofline on this). Substring (not suffix) match by design: packed
     multi-tenant kinds carry a trailing ``:tK`` after the tier tag."""
     return "f32-polish" if ":p32" in kind else "f64"
+
+
+#: Direction-kernel tier knob (docs/perf_pallas_linalg.md): which
+#: batched dense factorize/solve implementation the linalg dispatch
+#: seam (:func:`pycatkin_tpu.ops.linalg.select_solver`) routes bucket-
+#: shaped systems through. "xla" = the historical arithmetic-op
+#: kernels (lax.fori_loop LU / unrolled Gauss-Jordan), "pallas" = the
+#: VMEM-resident Pallas kernels of :mod:`pycatkin_tpu.ops.pallas_linalg`,
+#: "auto" (default) = pallas on TPU, xla elsewhere (unless
+#: PYCATKIN_LINALG_INTERPRET=1 forces the interpret-mode kernel for
+#: CPU testing).
+KERNEL_ENV = "PYCATKIN_LINALG_KERNEL"
+INTERPRET_ENV = "PYCATKIN_LINALG_INTERPRET"
+KERNELS = ("auto", "pallas", "xla")
+
+
+def _interpret_forced() -> bool:
+    """PYCATKIN_LINALG_INTERPRET truthiness (CPU testing escape hatch
+    for ``auto``; the Pallas kernels always run ``interpret=True`` off
+    TPU regardless, so nothing ever requires hardware)."""
+    return os.environ.get(INTERPRET_ENV, "").strip().lower() in (
+        "1", "on", "true", "yes")
+
+
+def linalg_kernel(backend: str = None) -> str:
+    """The resolved direction-kernel tier: ``"pallas"`` or ``"xla"``.
+
+    Resolved from PYCATKIN_LINALG_KERNEL at every call (process-level
+    configuration, never baked into a traced program -- program caches
+    key on it via :func:`kernel_tag`, exactly like the precision tier).
+    ``auto`` resolves by executing backend: pallas on TPU (the roofline
+    attack), xla everywhere else -- unless PYCATKIN_LINALG_INTERPRET=1
+    opts the interpret-mode kernel in for CPU testing. Unknown values
+    raise immediately -- a typo must not silently change the kernel."""
+    val = os.environ.get(KERNEL_ENV, "auto").strip() or "auto"
+    if val not in KERNELS:
+        raise ValueError(
+            f"{KERNEL_ENV}={val!r}: unknown linalg kernel "
+            f"(expected one of {', '.join(KERNELS)})")
+    if val != "auto":
+        return val
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    if backend == "tpu":
+        return "pallas"
+    return "pallas" if _interpret_forced() else "xla"
+
+
+def kernel_tag(kernel: str = None) -> str:
+    """Program-key / fingerprint suffix for the direction-kernel tier.
+    Empty for ``xla`` so every pre-kernel program key, AOT cache entry
+    and exported pack stays byte-identical; the Pallas tier gets a
+    distinct ``:kpl`` tag so kernel and XLA programs can never share an
+    AOT entry.
+
+    Tag composition order is a contract: the kernel tag is appended
+    AFTER the precision-tier tag (:func:`tier_tag`'s ``:p32``) and
+    BEFORE the sharding / multi-tenant tags, so a packed f32-polish
+    Pallas kind ends ``...:p32:kpl:t4``. Both inverses stay valid under
+    that order -- :func:`kernel_of_tag` matches ``:kpl`` anywhere in
+    the kind."""
+    if kernel is None:
+        kernel = linalg_kernel()
+    return ":kpl" if kernel == "pallas" else ""
+
+
+def kernel_of_tag(kind: str) -> str:
+    """Inverse of :func:`kernel_tag` over a program kind string: which
+    direction-kernel tier a registered program was built for (the cost
+    ledger annotates its rows with this, so perfwatch scores the
+    Pallas path against the XLA path program-by-program)."""
+    return "pallas" if ":kpl" in kind else "xla"
+
+
+def kernel_keyed(cached_fn):
+    """Decorator for ``lru_cache``d jitted-program builders whose
+    traces embed direction solves: appends the RESOLVED kernel tier
+    (:func:`linalg_kernel`) as a trailing ``kernel`` keyword on every
+    call, so flipping PYCATKIN_LINALG_KERNEL selects a DIFFERENT
+    cached program. The builders bake ``select_solver``'s choice in at
+    trace time; without this key a stale trace would silently serve
+    the wrong kernel tier after an env flip -- the exact staleness
+    class the explicit ``tier`` cache parameter already guards
+    against. The wrapped builder must accept a ``kernel`` keyword
+    (used only as a cache key); ``cache_clear``/``cache_info`` pass
+    through."""
+    @functools.wraps(cached_fn)
+    def wrapper(*args, **kwargs):
+        kwargs.setdefault("kernel", linalg_kernel())
+        return cached_fn(*args, **kwargs)
+    wrapper.cache_clear = cached_fn.cache_clear
+    wrapper.cache_info = cached_fn.cache_info
+    return wrapper
 
 
 def bulk_dtype(tier: str):

@@ -155,11 +155,24 @@ def _direction_factor(A, opts: SolverOptions | None):
     matrices measure cond ~1e10-1e15 AFTER row equilibration, beyond
     f32 refinement's ~1e7 contraction ceiling, at every pseudo-time
     scale (the 1e-14 dt clip floor keeps I/dt from ever dominating a
-    ||J|| ~ 1e16+ Jacobian)."""
+    ||J|| ~ 1e16+ Jacobian).
+
+    All of that policy now lives behind the one dispatch seam,
+    ``linalg.select_solver`` (docs/perf_pallas_linalg.md): the Pallas
+    kernel tier (``PYCATKIN_LINALG_KERNEL``) factors bucket-shaped
+    systems once and reuses the VMEM-resident factorization per chord
+    step; the XLA tier reproduces the historical branching exactly --
+    chord-enabled LARGE-n factors once (LU), SMALL-n keeps the direct
+    per-RHS gauss_solve kernel (chord-on/chord-off numerics agree
+    exactly, re-solving is cheap at unrolled sizes)."""
+    n = A.shape[-1]
+    choice = linalg.select_solver(n)
+    if choice.path == "pallas":
+        return choice.make_solve(A)
     if (opts is not None and opts.chord_steps > 0
-            and A.shape[-1] > linalg.UNROLL_MAX):
-        return linalg.make_msolve(A)
-    return lambda b: linalg.solve(A, b)
+            and n > linalg.UNROLL_MAX):
+        return choice.make_solve(A)
+    return lambda b: choice.solve(A, b)
 
 
 def _direction_solve(A, b, opts: SolverOptions | None = None):

@@ -1,0 +1,447 @@
+"""Pallas batched-LU direction kernels (PYCATKIN_LINALG_KERNEL=pallas).
+
+The contract that makes the kernel tier safe to flip on
+(docs/perf_pallas_linalg.md):
+
+1.  EQUIVALENCE -- lane for lane, the interpret-mode kernel is a
+    BITWISE twin of the XLA-op LU in :mod:`pycatkin_tpu.ops.linalg`
+    (same arithmetic in the same order), at every ABI bucket shape and
+    in both tier bulk dtypes. Under ``vmap`` (and for multi-column
+    RHS) the XLA reference batches its contractions (reduction
+    reorder), so those comparisons carry a tiny measured envelope; the
+    vmapped KERNEL stays bitwise equal to its own solo runs (one grid
+    program per lane).
+
+2.  PIVOTING -- row-permuted and badly row-scaled systems factor
+    accurately; a singular lane divides by a zero pivot and yields
+    non-finite output WITHOUT perturbing its batch neighbours (the
+    quarantine semantics the sweep relies on).
+
+3.  DISPATCH -- :func:`pycatkin_tpu.ops.linalg.select_solver` routes
+    through Pallas only when the kernel tier is resolved AND n is a
+    static ABI bucket; with the kernel resolved to ``xla`` (the
+    off-TPU default) the historical gauss/LU selection is reproduced
+    exactly.
+
+4.  IDENTITY -- Pallas and XLA programs never share a cache entry:
+    kind strings carry the ``:kpl`` tag (after the ``:p32`` tier tag),
+    and the xla tag is empty, so every pre-kernel program key / AOT
+    entry stays byte-identical. Cost-ledger rows of tagged programs
+    carry a ``kernel`` column; untagged rows are unchanged.
+
+5.  SWEEPS -- an ABI-bucketed sweep under the forced kernel
+    (``PYCATKIN_LINALG_KERNEL=pallas`` + ``PYCATKIN_LINALG_INTERPRET=1``
+    on CPU) reproduces the XLA sweep's verdict masks bitwise, keeps
+    solved states inside the solver-tolerance envelope, keeps packed
+    multi-tenant runs bitwise equal to their solo runs, and spends
+    zero post-warmup recompiles under the pcsan recompile sanitizer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pycatkin_tpu import engine, precision
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.obs import costs
+from pycatkin_tpu.ops import linalg
+from pycatkin_tpu.ops import pallas_linalg as plk
+from pycatkin_tpu.parallel import batch, compile_pool
+from pycatkin_tpu.parallel.batch import (broadcast_conditions,
+                                         clear_program_caches,
+                                         packed_sweep_steady_state,
+                                         sweep_steady_state)
+from pycatkin_tpu.san import recompile
+from pycatkin_tpu.solvers.newton import SolverOptions
+
+# n=512 interpret-mode factorizations compile+run in seconds each; one
+# representative case rides the slow marker, the fast buckets cover
+# the logic in tier-1.
+FAST_BUCKETS = (16, 32, 128)
+
+# Measured vmapped-comparison envelope (CPU, f64): the batched XLA
+# reference reassociates its contractions; observed maxrel ~2e-14 at
+# n=32, ~1.4e-12 at n=128 on the well-conditioned corpus.
+_VMAP_TOL = dict(rtol=1e-9, atol=1e-13)
+
+
+def _well_conditioned(n, lanes=None, dtype=jnp.float64, seed=0):
+    """Random square system(s) pushed diagonally dominant-ish."""
+    rng = np.random.default_rng(seed)
+    shape = (n, n) if lanes is None else (lanes, n, n)
+    A = rng.standard_normal(shape) + 4.0 * np.eye(n)
+    bshape = (n,) if lanes is None else (lanes, n)
+    b = rng.standard_normal(bshape)
+    return jnp.asarray(A, dtype), jnp.asarray(b, dtype)
+
+
+def _bits(x):
+    return np.asarray(x).tobytes()
+
+
+# ------------------------------------------------------------- equivalence
+
+
+@pytest.mark.parametrize("n", FAST_BUCKETS)
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.float32],
+                         ids=["f64", "f32"])
+def test_factor_bitwise_vs_xla(n, dtype):
+    A, _ = _well_conditioned(n, dtype=dtype, seed=n)
+    LU_p, perm_p = jax.jit(plk.lu_factor)(A)
+    LU_x, perm_x = jax.jit(linalg.lu_factor)(A)
+    assert perm_p.dtype == jnp.int32
+    assert np.array_equal(np.asarray(perm_p), np.asarray(perm_x))
+    assert _bits(LU_p) == _bits(LU_x), \
+        f"n={n}: kernel LU not bit-identical to the XLA LU"
+
+
+@pytest.mark.slow
+def test_factor_bitwise_vs_xla_512():
+    A, _ = _well_conditioned(512, seed=512)
+    LU_p, perm_p = jax.jit(plk.lu_factor)(A)
+    LU_x, perm_x = jax.jit(linalg.lu_factor)(A)
+    assert np.array_equal(np.asarray(perm_p), np.asarray(perm_x))
+    assert _bits(LU_p) == _bits(LU_x)
+
+
+@pytest.mark.parametrize("n", FAST_BUCKETS)
+def test_solve_bitwise_vs_xla(n):
+    A, b = _well_conditioned(n, seed=n + 1)
+    LU, perm = linalg.lu_factor(A)
+    x_p = jax.jit(plk.lu_solve)(LU, perm, b)
+    x_x = jax.jit(linalg.lu_solve)(LU, perm, b)
+    assert _bits(x_p) == _bits(x_x)
+    # Matrix RHS ([n, k]): XLA vectorizes the k-column contractions
+    # differently per program (reduction reorder), so the multi-RHS
+    # comparison carries the envelope, like the vmapped one.
+    B = jnp.stack([b, 2.0 * b], axis=-1)
+    X_p = jax.jit(plk.lu_solve)(LU, perm, B)
+    X_x = jax.jit(linalg.lu_solve)(LU, perm, B)
+    assert X_p.shape == (n, 2)
+    assert np.allclose(np.asarray(X_p), np.asarray(X_x), **_VMAP_TOL)
+
+
+@pytest.mark.parametrize("n", FAST_BUCKETS)
+def test_fused_factor_solve_matches_composition(n):
+    A, b = _well_conditioned(n, seed=n + 2)
+    fused = jax.jit(plk.factor_solve)(A, b)
+    composed = plk.lu_solve(*plk.lu_factor(A), b)
+    assert _bits(fused) == _bits(composed)
+    x_x = linalg.lu_solve(*linalg.lu_factor(A), b)
+    assert _bits(fused) == _bits(x_x)
+
+
+def test_make_msolve_reuses_factorization():
+    """The chord contract: factor once, solve many -- each solve
+    bitwise equal to the one-shot fused path."""
+    n = 32
+    A, b = _well_conditioned(n, seed=7)
+    msolve = plk.make_msolve(A)
+    for scale in (1.0, -2.5, 1e6):
+        r = scale * b
+        assert _bits(msolve(r)) == _bits(plk.factor_solve(A, r))
+
+
+def test_vmap_matches_solo_lanes_bitwise():
+    """vmap lifts the lane axis into the kernel grid -- one grid
+    program per lane, so each vmapped lane must reproduce its solo
+    run bitwise (there is no cross-lane batching to reassociate)."""
+    n, lanes = 32, 6
+    A, b = _well_conditioned(n, lanes=lanes, seed=9)
+    xs = jax.jit(jax.vmap(plk.factor_solve))(A, b)
+    for i in range(lanes):
+        assert _bits(xs[i]) == _bits(plk.factor_solve(A[i], b[i])), \
+            f"lane {i} drifted from its solo run"
+
+
+def test_vmap_envelope_vs_xla():
+    """The vmapped XLA reference batches its contractions (reduction
+    reorder), so lane batches agree to the documented envelope, not
+    the ulp."""
+    n, lanes = 32, 8
+    A, b = _well_conditioned(n, lanes=lanes, seed=11)
+    x_p = jax.jit(jax.vmap(plk.factor_solve))(A, b)
+    x_x = jax.jit(jax.vmap(
+        lambda a, r: linalg.lu_solve(*linalg.lu_factor(a), r)))(A, b)
+    assert np.allclose(np.asarray(x_p), np.asarray(x_x), **_VMAP_TOL)
+
+
+# ---------------------------------------------------------------- pivoting
+
+
+def test_row_permuted_system_pivots_correctly():
+    n = 32
+    rng = np.random.default_rng(13)
+    A, b = _well_conditioned(n, seed=13)
+    shuffled = jnp.asarray(np.asarray(A)[rng.permutation(n)])
+    x = plk.factor_solve(shuffled, b)
+    ref = np.linalg.solve(np.asarray(shuffled), np.asarray(b))
+    assert np.allclose(np.asarray(x), ref, rtol=1e-10, atol=1e-12)
+    # The permutation is genuinely non-trivial.
+    _, perm = plk.lu_factor(shuffled)
+    assert not np.array_equal(np.asarray(perm), np.arange(n))
+
+
+def test_ill_conditioned_rows_match_xla_bitwise():
+    """Rows scaled over ~12 decades: partial pivoting picks the same
+    pivots as the XLA path, so the factorization stays a bitwise
+    twin even where the numerics are ugly."""
+    n = 32
+    A, b = _well_conditioned(n, seed=17)
+    scale = jnp.asarray(np.logspace(-6, 6, n))
+    As = A * scale[:, None]
+    assert _bits(plk.factor_solve(As, b)) == \
+        _bits(linalg.lu_solve(*linalg.lu_factor(As), b))
+
+
+def test_singular_lane_goes_nonfinite_without_poisoning_neighbours():
+    n, lanes = 16, 3
+    A, b = _well_conditioned(n, lanes=lanes, seed=19)
+    A = A.at[1].set(A.at[1, 0].get() * 0.0)  # lane 1: all-zero matrix
+    xs = jax.jit(jax.vmap(plk.factor_solve))(A, b)
+    assert not np.all(np.isfinite(np.asarray(xs[1]))), \
+        "singular lane must yield non-finite output"
+    for i in (0, 2):
+        assert _bits(xs[i]) == _bits(plk.factor_solve(A[i], b[i])), \
+            f"healthy lane {i} was poisoned by the singular lane"
+        assert np.all(np.isfinite(np.asarray(xs[i])))
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def test_supported_is_exactly_the_bucket_table():
+    for n in plk.PALLAS_BUCKETS:
+        assert plk.supported(n)
+    for n in (1, 8, 20, 48, 64, 100, 256, 1024):
+        assert not plk.supported(n)
+    assert plk.PALLAS_BUCKETS == abi.SPECIES_BUCKETS
+
+
+def test_select_solver_xla_reproduces_historical_policy(monkeypatch):
+    monkeypatch.delenv(precision.KERNEL_ENV, raising=False)
+    monkeypatch.delenv(precision.INTERPRET_ENV, raising=False)
+    assert linalg.select_solver(16).path == "gauss"
+    assert linalg.select_solver(linalg.UNROLL_MAX).path == "gauss"
+    assert linalg.select_solver(linalg.UNROLL_MAX + 1).path == "lu"
+    assert linalg.select_solver(128).path == "lu"
+    assert linalg.select_solver(128).kernel == "xla"
+
+
+def test_select_solver_forced_pallas(monkeypatch):
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    for n in plk.PALLAS_BUCKETS:
+        choice = linalg.select_solver(n)
+        assert choice.path == "pallas" and choice.kernel == "pallas"
+        assert choice.solve is plk.factor_solve
+        assert choice.make_solve is plk.make_msolve
+    # Non-bucket shapes fall back to the historical policy even with
+    # the kernel forced.
+    assert linalg.select_solver(20).path == "gauss"
+    assert linalg.select_solver(100).path == "lu"
+
+
+def test_select_solver_auto_resolution(monkeypatch):
+    """auto == xla on CPU unless interpret mode is explicitly forced;
+    nothing here may depend on TPU hardware."""
+    monkeypatch.setenv(precision.KERNEL_ENV, "auto")
+    monkeypatch.delenv(precision.INTERPRET_ENV, raising=False)
+    assert precision.linalg_kernel("cpu") == "xla"
+    assert precision.linalg_kernel("tpu") == "pallas"
+    monkeypatch.setenv(precision.INTERPRET_ENV, "1")
+    assert precision.linalg_kernel("cpu") == "pallas"
+    monkeypatch.setenv(precision.KERNEL_ENV, "nonsense")
+    with pytest.raises(ValueError, match="PYCATKIN_LINALG_KERNEL"):
+        precision.linalg_kernel("cpu")
+
+
+def test_solve_and_make_msolve_shims_route_through_seam(monkeypatch):
+    """The legacy entry points are thin shims over select_solver: with
+    the kernel forced they serve bucket shapes through Pallas."""
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    monkeypatch.setenv(precision.INTERPRET_ENV, "1")
+    A, b = _well_conditioned(16, seed=23)
+    assert _bits(linalg.solve(A, b)) == _bits(plk.factor_solve(A, b))
+    assert _bits(linalg.make_msolve(A)(b)) == \
+        _bits(plk.make_msolve(A)(b))
+    # Unforced on CPU (interpret opt-in cleared too -- auto would
+    # otherwise still resolve to pallas): the historical gauss path.
+    monkeypatch.delenv(precision.KERNEL_ENV, raising=False)
+    monkeypatch.delenv(precision.INTERPRET_ENV, raising=False)
+    assert _bits(linalg.solve(A, b)) == _bits(linalg.gauss_solve(A, b))
+
+
+# ---------------------------------------------------------------- identity
+
+
+def test_kernel_tag_roundtrip(monkeypatch):
+    assert precision.kernel_tag("pallas") == ":kpl"
+    assert precision.kernel_tag("xla") == ""
+    assert precision.kernel_of_tag("steady:newton:opts:kpl") == "pallas"
+    assert precision.kernel_of_tag("steady:newton:opts") == "xla"
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    assert precision.kernel_tag() == ":kpl"
+    monkeypatch.delenv(precision.KERNEL_ENV, raising=False)
+
+
+def test_xla_kind_strings_byte_identical_to_pre_kernel(monkeypatch):
+    """The whole tiering is invisible until the env knob is set: kind
+    strings (hence program keys and AOT entries) with the kernel unset
+    or explicitly xla are byte-identical, carrying no ``:kpl``."""
+    opts = SolverOptions()
+    monkeypatch.delenv(precision.KERNEL_ENV, raising=False)
+    monkeypatch.delenv(precision.INTERPRET_ENV, raising=False)
+    unset = (batch._steady_kind(opts, "newton"),
+             batch._rescue_kind(opts),
+             batch._fused_kind(opts, 1e-12, "cpu", True, True))
+    monkeypatch.setenv(precision.KERNEL_ENV, "xla")
+    explicit = (batch._steady_kind(opts, "newton"),
+                batch._rescue_kind(opts),
+                batch._fused_kind(opts, 1e-12, "cpu", True, True))
+    assert unset == explicit
+    assert all(":kpl" not in k for k in unset)
+    args = (jnp.zeros((4, 3)),)
+    for a, bkind in zip(unset, explicit):
+        assert compile_pool.program_key(a, args) == \
+            compile_pool.program_key(bkind, args)
+
+
+def test_pallas_kind_strings_carry_kpl_after_tier_tag(monkeypatch):
+    opts = SolverOptions()
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    assert batch._steady_kind(opts, "newton").endswith(":kpl")
+    assert batch._rescue_kind(opts).endswith(":kpl")
+    fused32 = batch._fused_kind(opts, 1e-12, "cpu", True, True,
+                                tier="f32-polish")
+    assert ":p32:kpl" in fused32, \
+        "kernel tag must ride AFTER the tier tag"
+    # The screen program embeds no direction solves: never tagged.
+    assert ":kpl" not in batch._screen_kind(1e-12, "cpu")
+
+
+def test_cost_ledger_stamps_kernel_on_tagged_rows_only():
+    ledger = costs.CostLedger()
+    ledger.record("k1", kind="fused:opts:cpu:s1t1:kpl",
+                  cost={"flops": 1e9})
+    ledger.record("k2", kind="fused:opts:cpu:s1t1",
+                  cost={"flops": 1e9})
+    ledger.note_dispatch("k1", 0.5)
+    ledger.note_dispatch("k2", 0.5)
+    rows = ledger.snapshot()["programs"]
+    assert rows["k1"]["kernel"] == "pallas"
+    assert "kernel" not in rows["k2"], \
+        "untagged rows must stay byte-identical to pre-kernel ledgers"
+
+
+# ------------------------------------------------------------- sweep level
+
+N_LANES = 8
+
+
+@pytest.fixture(scope="module")
+def problem():
+    sim = synthetic_system(n_species=12, n_reactions=14, seed=4)
+    conds = broadcast_conditions(sim.conditions(), N_LANES)
+    conds = conds._replace(T=np.linspace(450.0, 700.0, N_LANES))
+    mask = engine.tof_mask_for(sim.spec, [sim.spec.rnames[-1]])
+    return sim.spec, conds, mask
+
+
+@pytest.fixture(autouse=True)
+def _sweep_env(monkeypatch):
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    monkeypatch.delenv(precision.KERNEL_ENV, raising=False)
+    monkeypatch.delenv(precision.INTERPRET_ENV, raising=False)
+    monkeypatch.delenv(precision.TIER_ENV, raising=False)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_caches():
+    clear_program_caches()
+    yield
+    clear_program_caches()
+
+
+def _forced_pallas(monkeypatch):
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    monkeypatch.setenv(precision.INTERPRET_ENV, "1")
+
+
+def test_sweep_verdicts_bitwise_under_forced_kernel(monkeypatch,
+                                                    problem):
+    """ABI buckets the 12-species system to n=16, so the forced kernel
+    carries the whole Newton direction load; verdict masks must
+    reproduce the XLA sweep bitwise, solved states agree like two
+    independently converged solutions."""
+    spec, conds, mask = problem
+    ref = sweep_steady_state(spec, conds, tof_mask=mask,
+                             check_stability=True)
+    _forced_pallas(monkeypatch)
+    out = sweep_steady_state(spec, conds, tof_mask=mask,
+                             check_stability=True)
+    for k in ("success", "stable", "quarantined"):
+        assert _bits(ref[k]) == _bits(out[k]), \
+            f"verdict {k!r} differs between kernel tiers"
+    tel_a = np.asarray(ref["lane_telemetry"])
+    tel_b = np.asarray(out["lane_telemetry"])
+    assert tel_a[:, 3].tobytes() == tel_b[:, 3].tobytes(), \
+        "telemetry strategy column differs between kernel tiers"
+    ok = np.asarray(ref["success"], dtype=bool)
+    # Cross-trajectory envelope: the legacy path solves these n=12
+    # systems with unrolled Gauss-Jordan while the forced sweep runs
+    # pallas-LU, so the two Newton iterations converge along different
+    # trajectories to the same root. Measured divergence on this
+    # problem: <= 1.6e-7 relative on non-tiny components, <= 1e-15
+    # absolute on near-zero ones (docs/perf_pallas_linalg.md).
+    assert np.allclose(np.asarray(ref["y"])[ok],
+                       np.asarray(out["y"])[ok],
+                       rtol=1e-5, atol=1e-12)
+
+
+def test_packed_tenants_bitwise_vs_solo_under_forced_kernel(
+        monkeypatch, problem):
+    """Both sides of the packed contract run the SAME kernel tier, so
+    the bitwise-vs-solo guarantee must survive the forced kernel."""
+    spec, conds, mask = problem
+    sim2 = synthetic_system(n_species=12, n_reactions=14, seed=5)
+    conds2 = broadcast_conditions(sim2.conditions(), N_LANES)
+    mask2 = engine.tof_mask_for(sim2.spec, [sim2.spec.rnames[-1]])
+    _forced_pallas(monkeypatch)
+    specs = [spec, sim2.spec]
+    all_conds = [conds, conds2]
+    masks = [mask, mask2]
+    solo = [sweep_steady_state(s, c, tof_mask=m,
+                               check_stability=True)
+            for s, c, m in zip(specs, all_conds, masks)]
+    packed = packed_sweep_steady_state(specs, all_conds,
+                                       tof_mask=masks,
+                                       check_stability=True)
+    for t, (a, b) in enumerate(zip(solo, packed)):
+        assert sorted(a) == sorted(b)
+        for k in sorted(a):
+            assert _bits(a[k]) == _bits(b[k]), \
+                f"tenant {t}: {k!r} not bit-identical to solo"
+
+
+def test_zero_post_warmup_recompiles_under_forced_kernel(monkeypatch,
+                                                         problem):
+    """The kernel path caches by kind like every other program: after
+    one warm sweep the pcsan recompile sanitizer must see NOTHING
+    compile on a re-run."""
+    spec, conds, mask = problem
+    _forced_pallas(monkeypatch)
+    recompile.reset()
+    recompile.activate()
+    try:
+        sweep_steady_state(spec, conds, tof_mask=mask,
+                           check_stability=True)
+        recompile.mark_warm()
+        sweep_steady_state(spec, conds, tof_mask=mask,
+                           check_stability=True)
+    finally:
+        recompile.deactivate()
+        recompile.reset()
