@@ -59,7 +59,11 @@
 # path (PYCATKIN_LINALG_KERNEL=pallas + PYCATKIN_LINALG_INTERPRET=1),
 # then a quick --linalg microbench proving every
 # (bucket x tier x kernel) cell runs and reports per-bucket MFU
-# against the measured matmul ceiling.
+# against the measured matmul ceiling. `keys-check` is the cache-key
+# integrity lane (pckey, docs/static_analysis.md): the PCL014
+# cache-key-completeness + PCL015 key-tag-discipline rules over the
+# tree, their mutation-tripwire fixture tests, and the trace-ident
+# jaxpr-fingerprint sanitizer suite run armed (PYCATKIN_SAN=1).
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
@@ -67,7 +71,7 @@ PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 .PHONY: test test-faults test-validate test-sharded test-san test-all \
 	lint lint-faults lint-syncs lint-baseline bench-smoke \
 	aot-pack-selftest obs-check perfwatch chaos serve-check \
-	router-check durable-check kernels-check
+	router-check durable-check kernels-check keys-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -120,6 +124,12 @@ kernels-check:
 		tests/test_pallas_linalg.py -q -m 'not slow' \
 		-p no:cacheprovider
 	env JAX_PLATFORMS=cpu python bench.py --linalg --quick
+
+keys-check:
+	python tools/pclint.py --rules PCL014,PCL015
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python -m pytest \
+		tests/test_pckey_lint.py tests/test_trace_ident.py -q \
+		-p no:cacheprovider
 
 aot-pack-selftest:
 	env JAX_PLATFORMS=cpu python tools/aot_pack.py selftest

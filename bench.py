@@ -746,8 +746,11 @@ def smoke_main():
     the packed multi-tenant contracts (zero marginal compiles, one
     sync, bitwise-vs-solo; ``packed_ok``), a direction-kernel breach
     (interpret-mode Pallas LU vs XLA LU bit-compare + forced-kernel
-    sweep verdict identity; ``kernels_ok``), or any pcsan runtime
-    tripwire firing on the sanitizer-guarded re-run (``san_ok``) -- the
+    sweep verdict identity; ``kernels_ok``), any pcsan runtime
+    tripwire firing on the sanitizer-guarded re-run (``san_ok``), or a
+    key-integrity breach (``keys_ok``: a program-key collision under
+    the armed trace-ident sanitizer, or pack-manifest jaxpr
+    fingerprints failing the export/audit/import round trip) -- the
     cheap
     end-to-end canary that the correctness gates and the pipelined
     executor survive integration, not a throughput record. Prints
@@ -796,6 +799,13 @@ def smoke_main():
     # the repo's real cache directory.
     with tempfile.TemporaryDirectory(prefix="pycatkin_smoke_") as tmp:
         os.environ["PYCATKIN_AOT_CACHE"] = tmp
+        # Trace-ident armed for the WHOLE lane (pckey): every program
+        # fingerprinted from the prewarm on, so the scratch cache's
+        # entries -- and the pack exported by the keys gate below --
+        # carry jaxpr fingerprints.
+        from pycatkin_tpu.san import trace_ident as _san_trace_ident
+        _san_trace_ident.reset()
+        _san_trace_ident.activate()
         t0 = time.perf_counter()
         n_prog = prewarm_sweep_programs(spec, conds, tof_mask=mask,
                                         buckets=(8,),
@@ -1072,6 +1082,66 @@ def smoke_main():
             else:
                 os.environ[_san.ENV] = prev_san
         san_ok = san_err is None
+
+        # Key-integrity gate (pckey): the trace-ident sanitizer armed
+        # since before the prewarm must report zero key collisions,
+        # and the scratch cache's fingerprints must survive a pack
+        # export -> manifest audit -> import round trip (the same
+        # audit `tools/aot_pack.py selftest` runs). Subprocess gates
+        # (serve/router/durable) write unfingerprinted entries into
+        # the shared scratch cache -- legal; the audit requires every
+        # CARRIED fingerprint to match this process's trace record.
+        keys_err = None
+        keys_rec = {}
+        try:
+            keys_rec = dict(_san_trace_ident.stats())
+            if keys_rec["collisions"]:
+                keys_err = (f"{keys_rec['collisions']} program-key "
+                            f"collision(s): one key bound to two "
+                            f"distinct jaxprs")
+            elif not keys_rec["programs"]:
+                keys_err = ("trace-ident recorded no programs -- the "
+                            "dispatch-seam hook is dead")
+            else:
+                import tarfile as _tarfile
+
+                from pycatkin_tpu.parallel import compile_pool as _cp
+                pack = os.path.join(tmp, "keys_gate_pack.tgz")
+                _cp.export_cache_pack(pack, cache_root=tmp)
+                with _tarfile.open(pack, "r:gz") as tar:
+                    man = json.load(tar.extractfile(_cp.PACK_MANIFEST))
+                carried = mismatched = 0
+                for key, meta in man.get("entries", {}).items():
+                    fp = meta.get("trace_ident")
+                    if not fp:
+                        continue
+                    carried += 1
+                    local = _san_trace_ident.fingerprint_for(key)
+                    if local is not None and local != fp:
+                        mismatched += 1
+                keys_rec.update(manifest_entries=len(
+                    man.get("entries", {})), fingerprinted=carried,
+                    mismatched=mismatched)
+                if not carried:
+                    keys_err = ("exported pack manifest carries no "
+                                "jaxpr fingerprints")
+                elif mismatched:
+                    keys_err = (f"{mismatched} manifest fingerprint(s) "
+                                f"disagree with locally-traced "
+                                f"programs")
+                else:
+                    # Import replays fingerprints through the armed
+                    # sanitizer: a contradiction raises here.
+                    imp_root = os.path.join(tmp, "keys_gate_import")
+                    _cp.import_cache_pack(pack, cache_root=imp_root)
+        except _san.SanError as e:
+            keys_err = str(e)
+        except Exception as e:  # noqa: BLE001 - gate reports & fails
+            keys_err = f"keys gate crashed: {e}"
+        finally:
+            _san_trace_ident.deactivate()
+            _san_trace_ident.reset()
+        keys_ok = keys_err is None
     n_ok = int(np.sum(np.asarray(out["success"])))
     clean = bool(np.all(np.asarray(out["success"])))
     # Only a CLEAN sweep is held to the budget: failed lanes buy the
@@ -1222,6 +1292,9 @@ def smoke_main():
         "durable_ok": durable_ok,
         "san_ok": san_ok,
         "san_error": san_err,
+        "keys_ok": keys_ok,
+        "keys_error": keys_err,
+        "keys": keys_rec,
         "lint_ok": True,
         "lint_findings": 0,
         "trace_ok": trace_ok,
@@ -1302,6 +1375,10 @@ def smoke_main():
         return 1
     if not san_ok:
         log(f"bench-smoke: FAIL -- sanitizer gate (pcsan): {san_err}")
+        return 1
+    if not keys_ok:
+        log(f"bench-smoke: FAIL -- key-integrity gate (pckey): "
+            f"{keys_err}")
         return 1
     if budget_breach:
         log(f"bench-smoke: FAIL -- program count over budget "

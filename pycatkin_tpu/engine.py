@@ -77,7 +77,10 @@ def free_energies(spec: ModelSpec, cond: Conditions) -> FreeEnergies:
         # (reference state.py:490-517 evaluated sequentially).
         b = spec.scl_b + spec.scl_We @ e_full + spec.scl_WuE @ cond.uE_rxn
         n_sc = spec.scl_idx.size
-        e_scl = linalg.solve(jnp.eye(n_sc) - spec.scl_Ws, b)
+        # scaling_solve, not solve: the builders caching this trace do
+        # not key on the kernel/tier knobs, so the solve path must not
+        # consult them (PCL014 cache-key-completeness).
+        e_scl = linalg.scaling_solve(jnp.eye(n_sc) - spec.scl_Ws, b)
         e_full = e_full.at[spec.scl_idx].set(e_scl)
 
     mods = spec.add0 + cond.eps

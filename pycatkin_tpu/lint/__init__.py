@@ -43,6 +43,20 @@ PCL013    fused-tail        cross-module: every function reachable from
                             the fused/packed sweep bodies (ProjectIndex
                             call graph) that materializes device values
                             is ``@hotpath``-decorated
+PCL014    cache-key-        cross-module taint: every ``lru_cache``d
+          completeness      program builder whose trace transitively
+                            resolves a runtime config source
+                            (``PYCATKIN_*`` env read or a declared
+                            resolver like ``precision.linalg_kernel``)
+                            threads that source as an explicit cache
+                            parameter (``kernel_keyed`` / ``tier``)
+PCL015    key-tag-          kind-string knob tags (tier/kernel/
+          discipline        sharding/tenant) obey the single declared
+                            ``KIND_TAG_GRAMMAR`` in
+                            ``parallel/compile_pool.py``: helpers build
+                            the declared literals, compositions follow
+                            grammar order, literals stay in their owner
+                            modules
 ========  ================  =============================================
 
 Suppressions: inline ``# pclint: disable=<rule> -- <reason>`` (any line

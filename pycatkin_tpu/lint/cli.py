@@ -31,9 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", default=None,
                    help="comma-separated rule IDs or names to run "
                         "(e.g. PCL001,tracer-leak); default: all")
-    p.add_argument("--format", choices=("text", "json", "sarif"),
+    p.add_argument("--format", choices=("text", "json", "sarif",
+                                        "github"),
                    default="text", dest="fmt",
-                   help="output format (default: text)")
+                   help="output format (default: text; `github` emits "
+                        "::error workflow annotations for Actions)")
     p.add_argument("--root", default=REPO_ROOT,
                    help=argparse.SUPPRESS)
     p.add_argument("--baseline", default=None,
@@ -90,6 +92,10 @@ def main(argv=None) -> int:
         print(report.to_json(result))
     elif args.fmt == "sarif":
         print(report.to_sarif(result, checkers))
+    elif args.fmt == "github":
+        gh = report.to_github(result)
+        if gh:
+            print(gh)
     else:
         print(report.format_text(result,
                                  verbose_suppressed=args.verbose))

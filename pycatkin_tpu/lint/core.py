@@ -213,9 +213,10 @@ def all_checkers() -> list[Checker]:
     the built-in checker modules on first use so plain
     ``import pycatkin_tpu.lint.core`` stays dependency-free."""
     from . import (abi_capture, async_blocking,  # noqa: F401
-                   atomic_write, dtype, env_registry, event_kinds,
-                   fault_sites, fused_tail, host_sync, lock_discipline,
-                   metric_names, purity, tracer)
+                   atomic_write, dataflow, dtype, env_registry,
+                   event_kinds, fault_sites, fused_tail, host_sync,
+                   key_tags, lock_discipline, metric_names, purity,
+                   tracer)
     return [_REGISTRY[rule]() for rule in sorted(_REGISTRY)]
 
 

@@ -1,0 +1,363 @@
+"""PCL014 cache-key completeness: the interprocedural taint engine.
+
+PR 18's bug class, machine-enforced. A runtime-resolved knob
+(``PYCATKIN_LINALG_KERNEL``) was read inside functions reachable from
+``lru_cache``d jitted-program builders; the knob was not part of the
+builders' cache keys, so an env flip silently served a stale trace of
+the other kernel tier. The fix threaded the RESOLVED knob through every
+builder as an explicit cache parameter (``precision.kernel_keyed``) --
+by hand, nine builders at a time. This module turns that contract into
+a lint rule over the :class:`~pycatkin_tpu.lint.project_index.
+ProjectIndex` call graph:
+
+    for every ``functools.lru_cache``d builder, walk everything its
+    body can reach; if the walk hits a CONFIG SOURCE -- a function
+    reading a ``PYCATKIN_*`` environment key, or a declared resolver
+    like :func:`pycatkin_tpu.precision.linalg_kernel` -- the builder
+    must thread that source as an explicit cache-key axis (the
+    ``kernel_keyed`` decorator for the kernel family, an explicit
+    ``tier`` parameter for the tier family), or carry a reasoned
+    inline suppression at its ``def`` line.
+
+Sources come in two layers:
+
+- **Detected**: any package function whose body reads
+  ``os.environ.get`` / ``os.getenv`` / ``os.environ[...]`` on a
+  ``PYCATKIN_*`` string -- literal, or a module-level constant
+  (``KERNEL_ENV = "PYCATKIN_LINALG_KERNEL"``) resolved through this
+  module's constant table. Module-level reads (import-time process
+  config) are not attributed to any function and are out of scope.
+- **Declared**: :data:`CONFIG_RESOLVERS` names the blessed resolver
+  functions and the cache-key mechanism that satisfies each family.
+  Declared resolvers are BFS *barriers*: their internal env reads are
+  their own business (``linalg_kernel`` absorbing
+  ``_interpret_forced``), reaching the resolver is what taints.
+
+:data:`TAINT_ABSORBERS` is the third, deliberately short list: call
+sites that consume a source WITHOUT baking it into the caller's trace.
+Today that is exactly ``ops.linalg.select_solver``'s tier read -- the
+tier there is shape introspection only (operand dtypes carry the
+precision; flipping the tier cannot change the emitted jaxpr), while
+its KERNEL read is the real trace-time bake the kernel family keys on.
+
+Satisfaction is deliberately strict for the kernel family: only the
+``kernel_keyed`` decorator counts, not a bare ``kernel`` parameter --
+the parameter without the wrapper is never filled with the resolved
+knob, which is precisely the PR 18 tripwire this rule must reproduce
+when one decorator is deleted.
+
+Runs once per lint pass over the shared index (``needs_index = True``),
+cached on the whole-package content key like PCL013.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from .core import Checker, Finding, register
+
+ENV_PREFIX = "PYCATKIN_"
+
+
+@dataclass(frozen=True)
+class ConfigResolver:
+    """One declared runtime-config resolver function."""
+
+    family: str          # short name for messages ("kernel", "tier")
+    env: str             # the env key the resolver reads
+    #: How a builder keys on this family: ``("decorator", name)`` --
+    #: the builder must be wrapped by ``name`` -- or ``("param", name)``
+    #: -- the builder must take ``name`` as an explicit argument.
+    keyed_by: tuple
+
+
+#: The blessed config-resolver registry: (module relpath, function
+#: name) -> how cached builders must key on it. Reaching one of these
+#: taints the builder with its family; the resolver's own body is a
+#: BFS barrier (its internal env reads are absorbed).
+CONFIG_RESOLVERS = {
+    ("pycatkin_tpu/precision.py", "linalg_kernel"): ConfigResolver(
+        family="kernel", env="PYCATKIN_LINALG_KERNEL",
+        keyed_by=("decorator", "kernel_keyed")),
+    ("pycatkin_tpu/precision.py", "kernel_tag"): ConfigResolver(
+        family="kernel", env="PYCATKIN_LINALG_KERNEL",
+        keyed_by=("decorator", "kernel_keyed")),
+    ("pycatkin_tpu/precision.py", "active_tier"): ConfigResolver(
+        family="tier", env="PYCATKIN_PRECISION_TIER",
+        keyed_by=("param", "tier")),
+}
+
+#: (module relpath, function name) -> families its subtree absorbs.
+#: ``select_solver``'s ``tier=None -> active_tier()`` default is shape
+#: introspection only: the operand dtypes carry the precision, so the
+#: tier can never change the trace this call emits. Its KERNEL read is
+#: NOT absorbed -- that one is the trace-time bake PR 18 tripped on.
+TAINT_ABSORBERS = {
+    ("pycatkin_tpu/ops/linalg.py", "select_solver"):
+        frozenset({"tier"}),
+}
+
+
+def _decorator_names(node) -> list:
+    """Trailing names of every decorator on ``node`` (``lru_cache(...)``
+    -> ``lru_cache``, ``_precision.kernel_keyed`` -> ``kernel_keyed``)."""
+    out = []
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name):
+            out.append(target.id)
+        elif isinstance(target, ast.Attribute):
+            out.append(target.attr)
+    return out
+
+
+def is_cached_builder(node) -> bool:
+    """Whether a function node is ``functools.lru_cache``-decorated
+    (the ``_lru_cache`` import alias counts; ``functools.cache`` is the
+    same trap)."""
+    return any(name in ("lru_cache", "_lru_cache", "cache")
+               for name in _decorator_names(node))
+
+
+def _param_names(node) -> set:
+    a = node.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg is not None:
+        names.add(a.vararg.arg)
+    if a.kwarg is not None:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def module_str_constants(tree) -> dict:
+    """Top-level ``NAME = "literal"`` assignments of one module AST --
+    the constant table env-key arguments resolve through."""
+    out = {}
+    for top in tree.body:
+        targets = []
+        if isinstance(top, ast.Assign):
+            targets = top.targets
+            value = top.value
+        elif isinstance(top, ast.AnnAssign) and top.value is not None:
+            targets = [top.target]
+            value = top.value
+        else:
+            continue
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = value.value
+    return out
+
+
+def _env_key_of(node, constants: dict) -> Optional[str]:
+    """The env-key string an ``os.environ``/``getenv`` argument node
+    resolves to (None when dynamic)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    return None
+
+
+def _is_environ(node) -> bool:
+    """``os.environ`` (Attribute) -- the base of ``.get`` and ``[...]``."""
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+def env_reads(fn_node, constants: dict) -> set:
+    """Every ``PYCATKIN_*`` env key a function's body reads through the
+    three blessed idioms (``os.environ.get``, ``os.getenv``,
+    ``os.environ[...]``), resolved through the module constant table."""
+    keys = set()
+    for node in ast.walk(fn_node):
+        arg = None
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and _is_environ(f.value) and node.args):
+                arg = node.args[0]
+            elif (isinstance(f, ast.Attribute) and f.attr == "getenv"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "os" and node.args):
+                arg = node.args[0]
+        elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+            arg = node.slice
+        if arg is None:
+            continue
+        key = _env_key_of(arg, constants)
+        if key is not None and key.startswith(ENV_PREFIX):
+            keys.add(key)
+    return keys
+
+
+@dataclass
+class TaintHit:
+    """One config source reached from one builder."""
+
+    source: tuple        # (relpath, fname) of the source function
+    resolver: Optional[ConfigResolver]   # None for detected env reads
+    env_keys: tuple      # env keys read (detected sources)
+    chain: tuple         # (relpath, fname) call chain builder -> source
+
+
+class TaintEngine:
+    """Interprocedural taint over one ProjectIndex: which config
+    sources each function can transitively reach."""
+
+    def __init__(self, index):
+        self.index = index
+        # (relpath, fname) -> frozenset of PYCATKIN_* keys read directly
+        self._direct: dict = {}
+        self._constants: dict = {}
+        for relpath, mod in index.modules.items():
+            consts = module_str_constants(mod.src.tree)
+            self._constants[relpath] = consts
+            for fname, info in mod.functions.items():
+                keys = env_reads(info.node, consts)
+                if keys:
+                    self._direct[(relpath, fname)] = frozenset(keys)
+
+    def direct_sources(self) -> dict:
+        """(relpath, fname) -> env keys, for every detected env-reading
+        function (the registry the docs quote)."""
+        return dict(self._direct)
+
+    def trace(self, root) -> list:
+        """Every :class:`TaintHit` reachable from ``root`` ((relpath,
+        fname)), honoring resolver barriers and absorber masks. BFS, so
+        the reported chain is a shortest witness path."""
+        hits: dict = {}
+        start = (root, frozenset())
+        parents = {start: None}
+        queue = deque([start])
+        while queue:
+            state = queue.popleft()
+            (node, masked) = state
+            resolver = CONFIG_RESOLVERS.get(node)
+            if resolver is not None and node != root:
+                if resolver.family not in masked and node not in hits:
+                    hits[node] = TaintHit(
+                        source=node, resolver=resolver, env_keys=(),
+                        chain=self._chain(parents, state))
+                continue                      # barrier: do not expand
+            direct = self._direct.get(node)
+            # The builder's OWN body reading env is the worst offender
+            # (no indirection to audit), so the root is not exempt --
+            # unless the root is itself a declared resolver, whose
+            # internal reads are its contract.
+            if (direct and node not in hits
+                    and not (node == root and node in CONFIG_RESOLVERS)):
+                hits[node] = TaintHit(
+                    source=node, resolver=None,
+                    env_keys=tuple(sorted(direct)),
+                    chain=self._chain(parents, state))
+            next_masked = masked | TAINT_ABSORBERS.get(node, frozenset())
+            for callee in self.index.callees(*node):
+                nxt = (callee, next_masked)
+                if nxt not in parents:
+                    parents[nxt] = state
+                    queue.append(nxt)
+        return [hits[k] for k in sorted(hits)]
+
+    @staticmethod
+    def _chain(parents, state) -> tuple:
+        out = []
+        while state is not None:
+            out.append(state[0])
+            state = parents[state]
+        return tuple(reversed(out))
+
+
+def _fmt_chain(chain) -> str:
+    return " -> ".join(f"{rel}:{fn}" for rel, fn in chain)
+
+
+@register
+class CacheKeyChecker(Checker):
+    rule = "PCL014"
+    name = "cache-key-completeness"
+    description = ("lru_cache'd program builder transitively reaches a "
+                   "runtime-config source (PYCATKIN_* env read / "
+                   "declared resolver) that is not threaded as an "
+                   "explicit cache-key axis (kernel_keyed / tier-style "
+                   "parameter)")
+    needs_index = True
+
+    def wants(self, relpath: str) -> bool:
+        return False                  # project-level rule only
+
+    def check_file(self, src) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, index) -> Iterable[Finding]:
+        # Registry drift is a finding, not a crash: a declared resolver
+        # that no longer resolves means the registry (or the function)
+        # moved and the rule is silently blind to its family.
+        for (relpath, fname) in sorted(CONFIG_RESOLVERS):
+            mod = index.modules.get(relpath)
+            if mod is None or fname not in mod.functions:
+                yield Finding(
+                    rule=self.rule, path="pycatkin_tpu/lint/dataflow.py",
+                    lineno=1, col=0,
+                    message=(f"CONFIG_RESOLVERS declares "
+                             f"{relpath}:{fname} but no such function "
+                             f"exists in the index -- update the "
+                             f"resolver registry"))
+        engine = TaintEngine(index)
+        for relpath in sorted(index.modules):
+            mod = index.modules[relpath]
+            for fname in sorted(mod.functions):
+                info = mod.functions[fname]
+                if not is_cached_builder(info.node):
+                    continue
+                yield from self._check_builder(engine, relpath, fname,
+                                               info, mod)
+
+    def _check_builder(self, engine, relpath, fname, info, mod):
+        decorators = _decorator_names(info.node)
+        params = _param_names(info.node)
+        seen_families = set()
+        for hit in engine.trace((relpath, fname)):
+            if hit.resolver is not None:
+                fam = hit.resolver.family
+                if fam in seen_families:
+                    continue
+                seen_families.add(fam)
+                mech, name = hit.resolver.keyed_by
+                satisfied = (name in decorators if mech == "decorator"
+                             else name in params)
+                if satisfied:
+                    continue
+                want = (f"wrap it with @{name}" if mech == "decorator"
+                        else f"take an explicit `{name}` parameter")
+                msg = (f"`{fname}` is lru_cache'd but its trace "
+                       f"transitively resolves the {fam} knob "
+                       f"({hit.resolver.env}) via "
+                       f"{_fmt_chain(hit.chain[1:])} -- an env flip "
+                       f"would serve a stale cached program; {want} so "
+                       f"the resolved knob joins the cache key, or "
+                       f"suppress here with the reason the trace is "
+                       f"{fam}-invariant")
+            else:
+                how = ("directly in its body" if len(hit.chain) == 1
+                       else f"via {_fmt_chain(hit.chain[1:])}")
+                msg = (f"`{fname}` is lru_cache'd but "
+                       f"{'transitively ' if len(hit.chain) > 1 else ''}"
+                       f"reads {', '.join(hit.env_keys)} {how} -- "
+                       f"thread the resolved value through as an "
+                       f"explicit cache parameter (kernel_keyed-style), "
+                       f"or suppress here with the reason the trace "
+                       f"cannot depend on it")
+            yield Finding(
+                rule=self.rule, path=relpath, lineno=info.lineno,
+                col=getattr(info.node, "col_offset", 0), message=msg,
+                source=mod.src.line(info.lineno).strip(),
+                end_lineno=info.lineno)

@@ -67,7 +67,7 @@ class EventKindChecker(Checker):
     name = "event-kinds"
     description = ("record_event kind not documented in "
                    "docs/failure_model.md")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def __init__(self, doc_path: Optional[str] = None):
         super().__init__()

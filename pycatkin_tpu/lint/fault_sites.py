@@ -92,7 +92,7 @@ class FaultSiteChecker(Checker):
     name = "fault-sites"
     description = ("fault-site label not documented in "
                    "docs/failure_model.md")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def __init__(self, doc_path: Optional[str] = None):
         super().__init__()

@@ -1,0 +1,252 @@
+"""PCL015 key-tag-discipline: kind-string tags obey the declared grammar.
+
+Program kinds compose runtime-knob tags (precision tier, direction
+kernel, sharding, tenant count) as ordered suffixes; the order and the
+literals used to be informal prose spread over three perf docs. They
+are now ONE declared artifact -- ``KIND_TAG_GRAMMAR`` in
+:mod:`pycatkin_tpu.parallel.compile_pool` -- and this rule checks the
+tree against it:
+
+1. **Declaration integrity** -- the grammar parses as a pure literal,
+   every entry's helper function exists in its declared owner module,
+   and the helper's body actually constructs the declared literal (a
+   helper edited away from its grammar row is drift, caught here).
+2. **Composition order** -- any f-string or string-concatenation that
+   calls two or more tag helpers must call them in grammar order
+   (tier, kernel, sharding, tenant). Out-of-order tags produce keys
+   that never match their prewarmed/exported twins: silent zoo bloat.
+3. **Tag ownership** -- the tag literals themselves may appear only in
+   their owner modules (plus the grammar declaration and the tag
+   helpers' home, ``precision.py`` / ``compile_pool.py``). Everyone
+   else must go through the helpers (``tier_of_tag`` /
+   ``kernel_of_tag`` / ``strip_kind_tags``), so a grammar change is a
+   one-module change.
+
+The grammar is read from ``compile_pool.py``'s AST -- lint never
+imports package code -- so this rule needs the project index and is
+cached on the whole-package content key: editing the grammar or any
+tag helper invalidates the cached verdict.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, register
+
+GRAMMAR_MODULE = "pycatkin_tpu/parallel/compile_pool.py"
+GRAMMAR_NAME = "KIND_TAG_GRAMMAR"
+_REQUIRED_KEYS = ("name", "literal", "strip", "owner", "helper")
+
+# Literals shorter than this are too generic to police by substring
+# (the tenant tag ":t" would match ":tof", ":tier", ...); those tags
+# are still covered by the declaration and ordering checks.
+_MIN_OWNED_LITERAL = 4
+
+
+def load_grammar(tree: ast.AST):
+    """(grammar tuple, assign node) parsed out of the compile_pool AST,
+    or (None, None) when the declaration is missing or not a pure
+    literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == GRAMMAR_NAME
+                   for t in node.targets):
+            continue
+        try:
+            grammar = ast.literal_eval(node.value)
+        except ValueError:
+            return None, node
+        return grammar, node
+    return None, None
+
+
+def _docstring_nodes(tree: ast.AST) -> set:
+    """id()s of every docstring Constant: prose, not key material."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Module, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        body = getattr(node, "body", [])
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            out.add(id(body[0].value))
+    return out
+
+
+def _str_constants(node: ast.AST, skip: set = frozenset()):
+    """Every non-docstring string-Constant descendant (f-string parts
+    included) with its anchor node."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and id(sub) not in skip):
+            yield sub
+
+
+def _helper_call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _concat_roots(tree: ast.AST):
+    """Top-level string-composition expressions: JoinedStr (f-strings)
+    and + -chains, widest-first so each composition is checked once."""
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.JoinedStr, ast.BinOp)):
+            if isinstance(node, ast.BinOp) and \
+                    not isinstance(node.op, ast.Add):
+                continue
+            if id(node) in seen:
+                continue
+            for sub in ast.walk(node):
+                if sub is not node:
+                    seen.add(id(sub))
+            yield node
+
+
+@register
+class KeyTagChecker(Checker):
+    rule = "PCL015"
+    name = "key-tag-discipline"
+    description = ("kind-string tag construction disagrees with the "
+                   "declared KIND_TAG_GRAMMAR (order, literal, or "
+                   "ownership)")
+    needs_index = True
+
+    def wants(self, relpath: str) -> bool:
+        return False                  # project-level rule only
+
+    def check_file(self, src) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, index) -> Iterable[Finding]:
+        mod = index.modules.get(GRAMMAR_MODULE)
+        if mod is None:
+            yield self._drift(f"{GRAMMAR_MODULE} is not in the project "
+                              f"index; the tag grammar cannot be checked")
+            return
+        grammar, decl = load_grammar(mod.src.tree)
+        if grammar is None:
+            where = f"line {decl.lineno}" if decl is not None else "anywhere"
+            yield self._drift(
+                f"{GRAMMAR_NAME} not parseable as a pure literal at "
+                f"{where} of {GRAMMAR_MODULE}; keep the declaration "
+                f"literal so lint can read it without importing")
+            return
+
+        bad = [e for e in grammar
+               if not isinstance(e, dict)
+               or any(k not in e for k in _REQUIRED_KEYS)]
+        if bad:
+            yield self._drift(
+                f"{GRAMMAR_NAME} entries must be dicts with keys "
+                f"{_REQUIRED_KEYS}; got {bad[0]!r}")
+            return
+
+        yield from self._check_declaration(index, grammar, decl)
+        order = {e["helper"]: i for i, e in enumerate(grammar)}
+        names = [e["name"] for e in grammar]
+        for relpath, m in sorted(index.modules.items()):
+            yield from self._check_order(relpath, m, order, names)
+            yield from self._check_ownership(relpath, m, grammar, decl)
+
+    # -- 1. declaration integrity ------------------------------------
+    def _check_declaration(self, index, grammar, decl):
+        for entry in grammar:
+            owner, helper = entry["owner"], entry["helper"]
+            mod = index.modules.get(owner)
+            info = mod.functions.get(helper) if mod else None
+            if info is None:
+                yield self._drift(
+                    f"grammar tag `{entry['name']}` declares helper "
+                    f"`{helper}` in {owner}, which does not exist "
+                    f"(update {GRAMMAR_NAME} alongside the helper)",
+                    lineno=decl.lineno)
+                continue
+            lit = entry["literal"]
+            skip = _docstring_nodes(info.node)
+            built = any(lit in c.value
+                        for c in _str_constants(info.node, skip))
+            if not built:
+                yield Finding(
+                    rule=self.rule, path=owner, lineno=info.lineno,
+                    col=getattr(info.node, "col_offset", 0),
+                    message=(f"tag helper `{helper}` no longer "
+                             f"constructs its declared literal "
+                             f"`{lit}` (grammar tag "
+                             f"`{entry['name']}`); update "
+                             f"{GRAMMAR_NAME} in {GRAMMAR_MODULE} in "
+                             f"the same change"),
+                    source=mod.src.line(info.lineno).strip(),
+                    end_lineno=info.lineno)
+
+    # -- 2. composition order ----------------------------------------
+    def _check_order(self, relpath, mod, order, names):
+        for root in _concat_roots(mod.src.tree):
+            calls = []
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Call):
+                    name = _helper_call_name(sub)
+                    if name in order:
+                        calls.append((sub.lineno, sub.col_offset,
+                                      order[name], name, sub))
+            calls.sort(key=lambda t: (t[0], t[1]))
+            ranks = [c[2] for c in calls]
+            if ranks == sorted(ranks):
+                continue
+            first_bad = next(c for i, c in enumerate(calls)
+                             if i and c[2] < calls[i - 1][2])
+            node = first_bad[4]
+            yield Finding(
+                rule=self.rule, path=relpath, lineno=node.lineno,
+                col=node.col_offset,
+                message=(f"kind-string tags composed out of grammar "
+                         f"order: `{first_bad[3]}` must come before "
+                         f"`{calls[calls.index(first_bad) - 1][3]}` "
+                         f"(declared order: {', '.join(names)}; see "
+                         f"{GRAMMAR_NAME} in {GRAMMAR_MODULE})"),
+                source=mod.src.line(node.lineno).strip(),
+                end_lineno=getattr(node, "end_lineno", node.lineno))
+
+    # -- 3. tag ownership --------------------------------------------
+    def _check_ownership(self, relpath, mod, grammar, decl):
+        if relpath.startswith("pycatkin_tpu/lint/"):
+            return                    # lint machinery talks about tags
+        allowed_always = {GRAMMAR_MODULE, "pycatkin_tpu/precision.py"}
+        skip = _docstring_nodes(mod.src.tree)
+        for entry in grammar:
+            lit = entry["literal"]
+            if len(lit) < _MIN_OWNED_LITERAL:
+                continue
+            if relpath in allowed_always or relpath == entry["owner"]:
+                continue
+            for const in _str_constants(mod.src.tree, skip):
+                if lit not in const.value:
+                    continue
+                yield Finding(
+                    rule=self.rule, path=relpath, lineno=const.lineno,
+                    col=const.col_offset,
+                    message=(f"literal kind-tag `{lit}` (grammar tag "
+                             f"`{entry['name']}`) outside its owner "
+                             f"{entry['owner']}: parse tags with the "
+                             f"inverse helpers (precision.tier_of_tag "
+                             f"/ kernel_of_tag, "
+                             f"compile_pool.strip_kind_tags) instead "
+                             f"of matching substrings"),
+                    source=mod.src.line(const.lineno).strip(),
+                    end_lineno=getattr(const, "end_lineno",
+                                       const.lineno))
+
+    def _drift(self, message: str, lineno: int = 1) -> Finding:
+        return Finding(
+            rule=self.rule, path=GRAMMAR_MODULE, lineno=lineno, col=0,
+            message=message, source="", end_lineno=lineno)

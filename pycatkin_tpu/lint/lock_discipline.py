@@ -98,7 +98,7 @@ class LockDisciplineChecker(Checker):
     name = "lock-discipline"
     description = ("access to a '# guarded-by: <lock>' attribute "
                    "outside a 'with self.<lock>:' block")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         for top in ast.walk(src.tree):

@@ -66,7 +66,7 @@ class MetricNameChecker(Checker):
     name = "metric-names"
     description = ("metric name not documented in the "
                    "docs/observability.md metrics catalog")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def __init__(self, doc_path: Optional[str] = None):
         super().__init__()

@@ -106,7 +106,7 @@ class JitPurityChecker(Checker):
     description = ("Python side effect inside a jitted function "
                    "(runs at trace time only, then silently never "
                    "again)")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         for fn in iter_jitted_functions(src.tree):

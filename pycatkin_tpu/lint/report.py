@@ -118,3 +118,27 @@ def to_sarif(result: LintResult, checkers: Iterable[Checker]) -> str:
         }],
     }
     return json.dumps(doc, indent=2)
+
+
+def _gh_escape(text: str) -> str:
+    """GitHub workflow-command data escaping (the property values have
+    their own, stricter escaping handled inline in :func:`to_github`)."""
+    return (text.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def to_github(result: LintResult) -> str:
+    """GitHub Actions annotation commands, one ``::error`` line per
+    ACTIVE finding: CI findings surface inline on the PR diff instead
+    of buried in a job log. Suppressed findings emit nothing -- the
+    annotation surface mirrors the exit code."""
+    lines = []
+    for f in result.active:
+        path = _gh_escape(f.path).replace(",", "%2C").replace(
+            ":", "%3A")
+        title = _gh_escape(f"pclint {f.rule}").replace(
+            ",", "%2C").replace(":", "%3A")
+        lines.append(f"::error file={path},line={f.lineno},"
+                     f"col={f.col + 1},title={title}::"
+                     f"{_gh_escape(f.message)}")
+    return "\n".join(lines)

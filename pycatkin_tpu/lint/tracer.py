@@ -114,7 +114,7 @@ class TracerLeakChecker(Checker):
     description = ("Python control flow or np.* host call on a traced "
                    "value inside a jitted function (compile-time "
                    "TracerBoolConversionError / silent constant-fold)")
-    scope = ("pycatkin_tpu/",)
+    scope = ("pycatkin_tpu/", "tools/", "bench.py", "bench_suite.py")
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         for fn in iter_jitted_functions(src.tree):

@@ -246,12 +246,13 @@ class CostLedger:
             kind = str(row.get("kind", ""))
             tier = _precision.tier_of_tag(kind)
             row["tier"] = tier
-            # Direction-kernel tier (":kpl" tag): lets perfwatch score
-            # a Pallas-kernel program against its XLA twin row-by-row.
-            # Only stamped on tagged rows so pre-kernel snapshots stay
-            # byte-identical.
-            if ":kpl" in kind:
-                row["kernel"] = _precision.kernel_of_tag(kind)
+            # Direction-kernel tier (kernel tag, KIND_TAG_GRAMMAR):
+            # lets perfwatch score a Pallas-kernel program against its
+            # XLA twin row-by-row. Only stamped on tagged rows so
+            # pre-kernel snapshots stay byte-identical.
+            kern = _precision.kernel_of_tag(kind)
+            if kern != "xla":
+                row["kernel"] = kern
             peak_f = peak_flops_for_tier(peak, tier)
             wall = row.get("blocked_wall_s", 0.0)
             n = row.get("dispatches", 0)

@@ -390,6 +390,24 @@ def solve(A: jnp.ndarray, b: jnp.ndarray):
     return select_solver(A.shape[-1]).solve(A, b)
 
 
+def scaling_solve(A: jnp.ndarray, b: jnp.ndarray):
+    """Knob-independent solve for the scaling-relation system.
+
+    The linear-scaling network in :func:`engine.free_energies` couples a
+    handful of descriptor states (``n_sc`` is a few, never a Pallas ABI
+    bucket), and its builders are cached WITHOUT the kernel/tier knobs
+    in their keys. Routing it through :func:`select_solver` would make
+    those traces depend on ``PYCATKIN_LINALG_KERNEL`` — exactly the
+    stale-trace class PCL014 polices. This path reads no runtime
+    config: unrolled Gauss-Jordan up to ``UNROLL_MAX``, sequential LU
+    beyond — the historical ``kernel=xla`` selection, byte-identical
+    under every knob setting.
+    """
+    if A.shape[-1] <= UNROLL_MAX:
+        return gauss_solve(A, b)
+    return _lu_solve_once(A, b)
+
+
 def make_mixed_solve(A: jnp.ndarray):
     """Factor A once in hardware float32, return an iteratively-refined
     solve closure: row-equilibrate in f64 (keeps the cast in f32 range

@@ -33,6 +33,7 @@ from ..frontend.spec import Conditions, ModelSpec
 from ..lint.hotpath import hotpath
 from ..obs import costs as _costs
 from ..san import recompile as _san_recompile
+from ..san import trace_ident as _san_trace_ident
 from ..obs import metrics as _metrics
 from ..solvers.newton import STRATEGY_CODES, SolverOptions
 from ..solvers.ode import ODEOptions
@@ -226,6 +227,9 @@ def _registered_call(spec: ModelSpec, kind: str, prog, args):
     # a never-seen key after mark_warm() is an in-band recompile about
     # to happen. One bool check when the sanitizer is off.
     _san_recompile.note_program(kind, key, args)
+    # pcsan trace-ident seam: fingerprint the jaxpr on the key's first
+    # sighting; a later distinct jaxpr under the same key raises.
+    _san_trace_ident.note_jaxpr(kind, key, prog, args)
     exe = compile_pool.lookup(spec, key)
     if exe is not None:
         t0 = _time_mod.perf_counter()
@@ -2008,6 +2012,11 @@ def prewarm_packed_sweep_programs(specs, conds, tof_mask=None,
         else:
             _san_recompile.note_compile(
                 f"packed fused sweep @{n_lanes} x{kb}")
+            # Compile is authoritative: force the fingerprint so a key
+            # collision raises AT the compile site, not a dispatch
+            # later (trace-ident sanitizer).
+            _san_trace_ident.note_jaxpr(kind, key, prog, args,
+                                        force=True)
             exe = call_with_backend_retry(
                 lambda: prog.lower(*args).compile(),
                 label=f"compile:packed fused sweep @{n_lanes} x{kb}")
@@ -2676,6 +2685,11 @@ def prewarm_sweep_programs(spec: ModelSpec, conds: Conditions,
         sharded executable is never deserialized into a process whose
         device population cannot satisfy it (silent miss, recompile)."""
         _san_recompile.note_compile(job["label"])
+        # Compile is authoritative: force the fingerprint so a key
+        # collision raises AT the compile site (trace-ident sanitizer).
+        _san_trace_ident.note_jaxpr(job["kind"], job["key"],
+                                    job["prog"], job["args"],
+                                    force=True)
         exe = call_with_backend_retry(
             lambda: job["prog"].lower(*job["args"]).compile(),
             label=f"compile:{job['label']}")

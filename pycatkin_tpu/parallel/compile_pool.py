@@ -102,6 +102,38 @@ def tenant_tag(k: int) -> str:
     return f":t{k}"
 
 
+# THE declared kind-string tag grammar (PCL015 key-tag-discipline).
+# A program kind is ``<base><tier><kernel><sharding><tenant>`` with the
+# tag segments appended in exactly this order by exactly these helpers;
+# every tag maps to the empty string in its default configuration so
+# legacy keys stay byte-identical. The lint rule parses this tuple out
+# of the module AST (it must stay a pure literal -- no computed
+# values), checks every literal tag construction and tag-helper body
+# against it, and ``strip_kind_tags`` below is its only inverse:
+# never strip or match tag substrings by hand elsewhere.
+KIND_TAG_GRAMMAR = (
+    {"name": "tier", "literal": ":p32", "strip": ":p32$",
+     "owner": "pycatkin_tpu/precision.py", "helper": "tier_tag"},
+    {"name": "kernel", "literal": ":kpl", "strip": ":kpl$",
+     "owner": "pycatkin_tpu/precision.py", "helper": "kernel_tag"},
+    {"name": "sharding", "literal": "@mesh[", "strip": "@mesh\\[.*$",
+     "owner": "pycatkin_tpu/parallel/batch.py", "helper": "_sharding_tag"},
+    {"name": "tenant", "literal": ":t", "strip": ":t\\d+$",
+     "owner": "pycatkin_tpu/parallel/compile_pool.py",
+     "helper": "tenant_tag"},
+)
+
+
+def strip_kind_tags(kind: str) -> str:
+    """Strip every grammar tag off a kind string, innermost-last: the
+    knob-free base kind. Two distinct keys whose stripped bases match
+    differ only in knob tags -- the trace-ident sanitizer uses this to
+    classify identical-jaxpr duplicates as knob-induced zoo bloat."""
+    for entry in reversed(KIND_TAG_GRAMMAR):
+        kind = re.sub(entry["strip"], "", kind)
+    return kind
+
+
 def spec_fingerprint(spec) -> str:
     """Content hash of a ModelSpec (field name + dtype/shape/bytes of
     every array field, repr of the rest) -- the identity a cached
@@ -382,6 +414,13 @@ class AOTCache:
             # mechanism in the bucket, and pack consumers audit that
             # claim from the manifest without parsing fingerprints.
             entry.update(abi_entry_fields(self.fingerprint))
+            # Jaxpr fingerprint of the program this executable was
+            # compiled from (trace-ident sanitizer, when armed): rides
+            # into pack manifests so imported packs are audited against
+            # locally-traced programs. Empty when the sanitizer never
+            # saw the key -- entries stay legal either way.
+            from ..san import trace_ident as _trace_ident
+            entry.update(_trace_ident.entry_fields(key))
             # Compile-time device-cost truth rides in the entry (and on
             # into pack manifests via _entry_meta): load() replays it
             # into the cost ledger, so cache-warmed processes still
@@ -533,7 +572,8 @@ def _entry_meta(path: str) -> dict:
             "sharding": entry.get("sharding", ""),
             "devices": entry.get("devices"),
             "size": os.path.getsize(path)}
-    for k in ("abi_version", "abi_bucket", "cost"):
+    for k in ("abi_version", "abi_bucket", "cost", "trace_ident",
+              "kind"):
         if k in entry:
             meta[k] = entry[k]
     return meta
@@ -666,6 +706,14 @@ def import_cache_pack(pack_path: str, cache_root: str | None = None,
             # its first manifest/bench snapshot.
             if isinstance(meta.get("cost"), dict):
                 _costs.record(key, cost=meta["cost"], source="pack")
+            # Replay manifest jaxpr fingerprints through the trace-ident
+            # sanitizer (no-op unless armed): an imported pack whose
+            # fingerprint contradicts a locally-traced program under the
+            # same key raises right here, not at first wrong dispatch.
+            if meta.get("trace_ident"):
+                from ..san import trace_ident as _trace_ident
+                _trace_ident.note_jaxpr(meta.get("kind", "?"), key,
+                                        fp=meta["trace_ident"])
             imported += 1
             total += len(blob)
     _metrics.counter("pycatkin_aot_pack_imports_total",

@@ -168,8 +168,27 @@ def kernel_keyed(cached_fn):
     the wrong kernel tier after an env flip -- the exact staleness
     class the explicit ``tier`` cache parameter already guards
     against. The wrapped builder must accept a ``kernel`` keyword
-    (used only as a cache key); ``cache_clear``/``cache_info`` pass
-    through."""
+    (used only as a cache key) -- checked at decoration time, so a
+    builder missing the parameter fails at import with a pointed
+    error instead of a confusing TypeError on first call;
+    ``cache_clear``/``cache_info`` pass through."""
+    import inspect
+
+    builder = getattr(cached_fn, "__wrapped__", cached_fn)
+    try:
+        params = inspect.signature(builder).parameters
+    except (TypeError, ValueError):
+        params = None                 # uninspectable: trust the caller
+    if params is not None and "kernel" not in params and not any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in params.values()):
+        raise TypeError(
+            f"kernel_keyed: {getattr(builder, '__qualname__', builder)!r}"
+            f" does not accept a `kernel` keyword -- the decorator "
+            f"threads the resolved PYCATKIN_LINALG_KERNEL tier through "
+            f"it as an lru_cache key parameter; add "
+            f"`kernel: str = 'xla'` to the builder signature")
+
     @functools.wraps(cached_fn)
     def wrapper(*args, **kwargs):
         kwargs.setdefault("kernel", linalg_kernel())

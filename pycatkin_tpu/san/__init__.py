@@ -3,7 +3,7 @@
 The static rules (:mod:`pycatkin_tpu.lint`) catch the IDIOMS of
 contract violations; this package catches the violations themselves,
 at the moment they happen, with the failing program/operand/callback
-in the exception message. Three tripwires, all off unless
+in the exception message. Four tripwires, all off unless
 ``PYCATKIN_SAN=1`` (or a test/bench arms them explicitly):
 
 - **recompile sanitizer** (:mod:`.recompile`): after ``mark_warm()``,
@@ -24,6 +24,12 @@ in the exception message. Three tripwires, all off unless
   ``PYCATKIN_SAN_STALL_S`` (default 0.2 s); the ``watchdog()`` context
   collects stall warnings and raises :class:`StallSanError` at exit.
   The runtime teeth behind PCL010's lexical check.
+- **trace-ident sanitizer** (:mod:`.trace_ident`): fingerprints the
+  jaxpr of every registered program; two distinct jaxprs under one
+  program key raise :class:`TraceIdentSanError` at the compile site,
+  identical jaxprs under knob-differing keys are counted as zoo
+  bloat. The runtime teeth behind PCL014/PCL015's static key
+  discipline (``bench.py --smoke``'s ``keys_ok`` gate).
 
 Wiring: ``make test-san`` runs the suite with ``PYCATKIN_SAN=1``
 (the pytest plugin :mod:`.plugin` arms everything), ``bench.py
@@ -65,14 +71,21 @@ class StallSanError(SanError):
     non-blocking serve contract broke."""
 
 
+class TraceIdentSanError(SanError):
+    """Two distinct jaxprs observed under one program key -- the
+    one-key-one-trace contract broke (wrong-answer risk)."""
+
+
 def install() -> None:
     """Arm every passive sanitizer (idempotent): the sync patches
     record-and-check only inside ``strict()`` regions, the recompile
-    recorder only trips after ``mark_warm()``."""
-    from . import recompile, syncs
+    recorder only trips after ``mark_warm()``, the trace-ident
+    recorder only trips on a fingerprint collision."""
+    from . import recompile, syncs, trace_ident
     syncs.install()
     recompile.activate()
+    trace_ident.activate()
 
 
 __all__ = ["ENV", "enabled", "install", "SanError", "RecompileSanError",
-           "SyncSanError", "StallSanError"]
+           "SyncSanError", "StallSanError", "TraceIdentSanError"]

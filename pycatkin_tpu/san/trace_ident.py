@@ -1,0 +1,190 @@
+"""Trace-identity sanitizer: one program key <-> one jaxpr, enforced.
+
+The program zoo's whole correctness story rests on a single invariant:
+a program key (kind string + operand signature + toolchain,
+:func:`~pycatkin_tpu.parallel.compile_pool.program_key`) names exactly
+one traced program. PR 18's stale-kernel bug was this invariant
+breaking silently -- two distinct traces served under one key, the
+wrong one winning depending on env-flip order. PCL014 now polices the
+cache-key side statically; this sanitizer polices the trace side
+dynamically:
+
+- **Collision (hard error):** two *distinct* jaxpr fingerprints
+  observed under one program key raise
+  :class:`~pycatkin_tpu.san.TraceIdentSanError` at the second
+  observation site (the compile site, for registered compiles) --
+  a key collision is a wrong-answer risk, never a perf footnote.
+- **Duplicate (counted):** the *same* jaxpr fingerprint under two
+  knob-differing keys (same base kind after
+  :func:`~pycatkin_tpu.parallel.compile_pool.strip_kind_tags`) is
+  legal but bloats the zoo against ``PREWARM_PROGRAM_BUDGET``;
+  :func:`duplicate_groups` / :func:`stats` expose the count so
+  ``bench.py --smoke`` and perfwatch can report it.
+
+Fingerprints are sha256 over the whitespace-canonicalized
+``jax.make_jaxpr`` text of the program on its concrete operands --
+the pre-XLA trace identity, stable across processes for a fixed
+jax version (the program key already pins the toolchain). They are
+recorded into AOT cache entries and pack manifests
+(``compile_pool.AOTCache.save`` / ``export_cache_pack``), and
+``import_cache_pack`` replays them through :func:`note_jaxpr`, so an
+imported pack whose fingerprints contradict locally-traced programs
+trips the same error.
+
+Everything is a no-op until :func:`activate` (armed by
+:func:`pycatkin_tpu.san.install` under ``PYCATKIN_SAN=1``, by
+``bench.py --smoke``'s keys gate, and by the ``aot_pack`` selftest).
+Tracing failures (e.g. a program that cannot be abstractly retraced)
+are counted, never raised: the sanitizer must not take down a path
+the real dispatch handles fine.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+
+from . import TraceIdentSanError
+
+_lock = threading.Lock()
+_active = False
+_by_key: dict = {}      # key -> (kind, fingerprint)
+_by_fp: dict = {}       # fingerprint -> [(kind, key), ...]
+_collisions: list = []  # (key, kind, old_fp, new_fp)
+_failures: int = 0      # fingerprinting attempts that raised
+
+_WS = re.compile(r"\s+")
+
+
+def activate() -> None:
+    global _active
+    _active = True
+
+
+def deactivate() -> None:
+    global _active
+    _active = False
+
+
+def is_active() -> bool:
+    return _active
+
+
+def reset() -> None:
+    """Forget every recorded fingerprint, collision and failure."""
+    global _failures
+    with _lock:
+        _by_key.clear()
+        _by_fp.clear()
+        _collisions.clear()
+        _failures = 0
+
+
+def fingerprint(prog, args) -> str:
+    """Jaxpr fingerprint of ``prog`` on concrete ``args``: sha256 of
+    the whitespace-canonicalized ``jax.make_jaxpr`` text, truncated to
+    32 hex chars (the program-key width). Raises whatever the trace
+    raises -- callers decide whether failure is fatal."""
+    import jax
+
+    text = str(jax.make_jaxpr(prog)(*args))
+    return hashlib.sha256(_WS.sub(" ", text).strip()
+                          .encode()).hexdigest()[:32]
+
+
+def note_jaxpr(kind: str, key: str, prog=None, args=None,
+               fp: str = None, force: bool = False) -> None:
+    """Record (or verify) the jaxpr fingerprint of one program key.
+
+    Dispatch-seam callers pass ``prog``/``args`` and let already-seen
+    keys return without retracing; compile-site callers pass
+    ``force=True`` (a compile is rare and authoritative -- a collision
+    must raise AT the compile site). Pack import passes a precomputed
+    ``fp``. Raises :class:`TraceIdentSanError` when ``key`` was
+    already bound to a different fingerprint."""
+    global _failures
+    if not _active:
+        return
+    if fp is None:
+        if prog is None:
+            return
+        if not force:
+            with _lock:
+                if key in _by_key:
+                    return
+        try:
+            fp = fingerprint(prog, args or ())
+        except Exception:
+            with _lock:
+                _failures += 1
+            return
+    with _lock:
+        bound = _by_key.get(key)
+        if bound is None:
+            _by_key[key] = (kind, fp)
+            _by_fp.setdefault(fp, []).append((kind, key))
+            return
+        if bound[1] == fp:
+            return
+        _collisions.append((key, kind, bound[1], fp))
+        old_kind, old_fp = bound
+    raise TraceIdentSanError(
+        f"trace-ident sanitizer: program key {key[:16]}... already "
+        f"bound to jaxpr {old_fp[:12]} (kind {old_kind!r}) but this "
+        f"{'compile' if force or prog is not None else 'record'} "
+        f"carries a DIFFERENT jaxpr {fp[:12]} (kind {kind!r}) -- one "
+        f"key must name one trace; a missing cache-key knob (PCL014) "
+        f"or a kind-string tag violation (PCL015) is the usual cause")
+
+
+def fingerprint_for(key: str) -> str | None:
+    with _lock:
+        bound = _by_key.get(key)
+    return bound[1] if bound else None
+
+
+def entry_fields(key: str) -> dict:
+    """Fields the AOT cache stamps into an entry/manifest for ``key``:
+    ``{"trace_ident": fp, "kind": kind}`` when the key was observed,
+    else ``{}`` (entries written by unarmed processes stay legal)."""
+    with _lock:
+        bound = _by_key.get(key)
+    if bound is None:
+        return {}
+    return {"trace_ident": bound[1], "kind": bound[0]}
+
+
+def duplicate_groups() -> list:
+    """Knob-induced zoo bloat: groups of >= 2 keys sharing one jaxpr
+    fingerprint whose kinds also share a stripped base kind -- i.e.
+    keys that differ ONLY in grammar tags yet trace to the identical
+    program. Each group is ``(fingerprint, [(kind, key), ...])``."""
+    from ..parallel import compile_pool
+
+    out = []
+    with _lock:
+        groups = {fp: list(members) for fp, members in _by_fp.items()
+                  if len(members) >= 2}
+    for fp, members in sorted(groups.items()):
+        bases = {compile_pool.strip_kind_tags(kind)
+                 for kind, _ in members}
+        if len(bases) < len({kind for kind, _ in members}):
+            out.append((fp, members))
+    return out
+
+
+def stats() -> dict:
+    """Snapshot for gates and reports: program/fingerprint counts,
+    collision count (MUST be zero -- a nonzero count means an error
+    was swallowed upstream), knob-duplicate groups, trace failures."""
+    dups = duplicate_groups()
+    with _lock:
+        return {
+            "programs": len(_by_key),
+            "fingerprints": len(_by_fp),
+            "collisions": len(_collisions),
+            "duplicate_groups": len(dups),
+            "duplicate_keys": sum(len(m) for _, m in dups),
+            "trace_failures": _failures,
+        }

@@ -1,0 +1,215 @@
+"""pckey static half: PCL014 cache-key-completeness + PCL015
+key-tag-discipline, proven by mutation.
+
+The tripwire contract (ISSUE 19): deleting one ``kernel_keyed``
+application from the REAL tree must reproduce the PR 18 stale-kernel
+bug as exactly one PCL014 finding, and the shipped tree must be at 0
+active findings. PCL015 is proven the same way -- swap two tag
+helpers, edit a helper literal, or leak a tag literal outside its
+owner module, and the declared-grammar checks fire; the real tree is
+silent. Mutations run on a scratch copy of the package so the checks
+exercise the real call graph, not a toy.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import pytest
+
+from pycatkin_tpu.lint.cache import LintCache
+from pycatkin_tpu.lint.core import run_lint
+from pycatkin_tpu.lint.dataflow import (CONFIG_RESOLVERS,
+                                        CacheKeyChecker)
+from pycatkin_tpu.lint.fused_tail import FusedTailChecker
+from pycatkin_tpu.lint.key_tags import GRAMMAR_NAME, KeyTagChecker
+from pycatkin_tpu.lint.project_index import ProjectIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KEYED_DECORATOR = ("@_precision.kernel_keyed\n"
+                   "@lru_cache(maxsize=16)\n"
+                   "def _steady_program(")
+
+
+def active(findings):
+    return [f for f in findings if f.suppressed is None]
+
+
+@pytest.fixture()
+def pkg_copy(tmp_path):
+    """Scratch copy of the real package tree, mutation-ready."""
+    shutil.copytree(
+        os.path.join(REPO, "pycatkin_tpu"),
+        tmp_path / "pycatkin_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"))
+    return tmp_path
+
+
+def _edit(root, relpath, old, new, count=1):
+    p = root / relpath
+    s = p.read_text(encoding="utf-8")
+    s2 = s.replace(old, new, count)
+    assert s2 != s, f"mutation pattern not found in {relpath}: {old!r}"
+    p.write_text(s2, encoding="utf-8")
+
+
+# ------------------------------------------------------------- PCL014
+
+def test_pcl014_real_tree_is_clean():
+    findings = list(CacheKeyChecker().check_project(
+        ProjectIndex.build(REPO)))
+    assert findings == [], [f"{f.path}:{f.lineno} {f.message}"
+                            for f in findings]
+
+
+def test_pcl014_resolver_registry_matches_tree():
+    """Registry drift is a finding in its own right: every declared
+    config resolver must still exist where the registry says."""
+    index = ProjectIndex.build(REPO)
+    for (relpath, fname) in CONFIG_RESOLVERS:
+        mod = index.modules.get(relpath)
+        assert mod is not None and fname in mod.functions, \
+            (relpath, fname)
+
+
+def test_pcl014_tripwire_kernel_keyed_removal(pkg_copy):
+    """THE acceptance tripwire: strip one kernel_keyed application and
+    the PR 18 bug class comes back as exactly one finding naming the
+    builder and the fix."""
+    _edit(pkg_copy, "pycatkin_tpu/parallel/batch.py",
+          KEYED_DECORATOR, KEYED_DECORATOR.split("\n", 1)[1])
+    result = run_lint(root=str(pkg_copy), checkers=[CacheKeyChecker()])
+    act = active(result.findings)
+    assert len(act) == 1, [f.message for f in act]
+    f = act[0]
+    assert f.rule == "PCL014"
+    assert f.path == "pycatkin_tpu/parallel/batch.py"
+    assert "_steady_program" in f.message
+    assert "kernel_keyed" in f.message
+    assert "PYCATKIN_LINALG_KERNEL" in f.message
+
+
+def test_pcl014_tripwire_inlined_env_read(pkg_copy):
+    """The other tripwire flavor: an env read inlined straight into a
+    cached builder body (no resolver indirection at all)."""
+    _edit(pkg_copy, "pycatkin_tpu/parallel/batch.py",
+          "def _tof_program(spec: ModelSpec):",
+          "def _tof_program(spec: ModelSpec):\n"
+          "    _flavor = os.environ.get(\"PYCATKIN_FUSED_SWEEP\", \"\")")
+    result = run_lint(root=str(pkg_copy), checkers=[CacheKeyChecker()])
+    act = active(result.findings)
+    assert len(act) == 1, [f.message for f in act]
+    assert "_tof_program" in act[0].message
+    assert "PYCATKIN_FUSED_SWEEP" in act[0].message
+
+
+def test_pcl014_reasoned_suppression_is_honored(pkg_copy):
+    _edit(pkg_copy, "pycatkin_tpu/parallel/batch.py",
+          KEYED_DECORATOR,
+          "@lru_cache(maxsize=16)\n"
+          "def _steady_program(  # pclint: disable=PCL014 -- test: "
+          "suppression plumbing for project-level taint findings\n")
+    # keep the original def line's remainder parseable: the mutation
+    # above turned `def _steady_program(` into a continuation, so put
+    # the opening back.
+    result = run_lint(root=str(pkg_copy), checkers=[CacheKeyChecker()])
+    assert active(result.findings) == [], \
+        [f.message for f in active(result.findings)]
+    sup = [f for f in result.findings if f.suppressed == "inline"]
+    assert len(sup) == 1 and "suppression plumbing" in sup[0].reason
+
+
+# ------------------------------------------------------------- PCL015
+
+def test_pcl015_real_tree_is_clean():
+    findings = list(KeyTagChecker().check_project(
+        ProjectIndex.build(REPO)))
+    assert findings == [], [f"{f.path}:{f.lineno} {f.message}"
+                            for f in findings]
+
+
+def test_pcl015_tag_order_swap_is_flagged(pkg_copy):
+    _edit(pkg_copy, "pycatkin_tpu/parallel/batch.py",
+          "{_precision.tier_tag(tier)}{_precision.kernel_tag()}",
+          "{_precision.kernel_tag()}{_precision.tier_tag(tier)}")
+    act = active(run_lint(root=str(pkg_copy),
+                          checkers=[KeyTagChecker()]).findings)
+    assert len(act) == 1, [f.message for f in act]
+    assert "out of grammar order" in act[0].message
+    assert "tier_tag" in act[0].message
+
+
+def test_pcl015_literal_outside_owner_is_flagged(pkg_copy):
+    (pkg_copy / "pycatkin_tpu" / "obs" / "sniff.py").write_text(
+        'def is_pallas(kind):\n    return ":kpl" in kind\n',
+        encoding="utf-8")
+    act = active(run_lint(root=str(pkg_copy),
+                          checkers=[KeyTagChecker()]).findings)
+    assert len(act) == 1, [f.message for f in act]
+    assert act[0].path == "pycatkin_tpu/obs/sniff.py"
+    assert "kernel_of_tag" in act[0].message
+
+
+def test_pcl015_helper_literal_drift_is_flagged(pkg_copy):
+    """A helper edited away from its grammar row (tier_tag no longer
+    builds the declared `:p32`) is declaration drift."""
+    _edit(pkg_copy, "pycatkin_tpu/precision.py",
+          'return "" if tier == "f64" else ":p32"',
+          'return "" if tier == "f64" else ":q32"')
+    act = active(run_lint(root=str(pkg_copy),
+                          checkers=[KeyTagChecker()]).findings)
+    assert any("no longer constructs its declared literal" in f.message
+               and f.path == "pycatkin_tpu/precision.py"
+               for f in act), [f.message for f in act]
+
+
+def test_pcl015_missing_grammar_is_drift(pkg_copy):
+    _edit(pkg_copy, "pycatkin_tpu/parallel/compile_pool.py",
+          "KIND_TAG_GRAMMAR = (", "_RENAMED_AWAY = (")
+    act = active(run_lint(root=str(pkg_copy),
+                          checkers=[KeyTagChecker()]).findings)
+    assert len(act) == 1
+    assert GRAMMAR_NAME in act[0].message
+
+
+# ----------------------- satellite 3: project-level cache invalidation
+
+def _project_run(root):
+    cache = LintCache(root)
+    result = run_lint(root=root,
+                      checkers=[FusedTailChecker(), KeyTagChecker()],
+                      cache=cache)
+    return cache, result
+
+
+def test_grammar_edit_invalidates_pcl015_cache(pkg_copy):
+    """Editing the declared tag grammar must cold-miss the cached
+    PCL013/PCL015 project verdicts -- a stale 'clean' here would let
+    tag drift ship."""
+    root = str(pkg_copy)
+    c1, _ = _project_run(root)
+    c1.save()
+    c2, _ = _project_run(root)
+    assert c2.misses == 0 and c2.hits >= 1      # warm baseline
+
+    _edit(pkg_copy, "pycatkin_tpu/parallel/compile_pool.py",
+          '{"name": "tier", "literal": ":p32"',
+          '{"name": "tier-renamed", "literal": ":p32"')
+    c3, _ = _project_run(root)
+    assert c3.misses >= 1, "grammar edit served a stale project verdict"
+
+
+def test_hotpath_decorator_edit_invalidates_project_cache(pkg_copy):
+    """Editing a @hotpath decoration (PCL013's registry input) must
+    re-key the project pass."""
+    root = str(pkg_copy)
+    c1, r1 = _project_run(root)
+    c1.save()
+
+    _edit(pkg_copy, "pycatkin_tpu/parallel/batch.py",
+          "@hotpath\ndef ", "@hotpath  # registry edit\ndef ", 1)
+    c2, r2 = _project_run(root)
+    assert c2.misses >= 1, \
+        "@hotpath edit served a stale project verdict"

@@ -17,6 +17,7 @@ checker's full-match regex.
 import ast
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -421,6 +422,170 @@ def test_json_and_sarif_outputs_parse():
 def test_cli_no_cache_flag_still_exits_zero():
     proc = _run_pclint("--no-cache")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# A condensed-but-faithful subset of the SARIF 2.1.0 schema (the full
+# OASIS document is ~15k lines and the container has no network; this
+# subset pins every structural property pclint emits, with
+# additionalProperties left open exactly where the spec leaves it
+# open). Validated with the jsonschema package already in the image.
+_SARIF_21_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string", "format": "uri"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object",
+                            "required": ["name"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "informationUri": {"type": "string"},
+                                "rules": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["id"],
+                                        "properties": {
+                                            "id": {"type": "string"},
+                                            "name": {"type": "string"},
+                                            "shortDescription": {
+                                                "type": "object",
+                                                "required": ["text"],
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        }},
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["message"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "level": {"enum": ["none", "note",
+                                                   "warning", "error"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                    "properties": {
+                                        "text": {"type": "string"}},
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "uri": {
+                                                                "type":
+                                                                "string"
+                                                            }},
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum":
+                                                                1},
+                                                            "startColumn":
+                                                            {"type":
+                                                             "integer",
+                                                             "minimum":
+                                                             1},
+                                                        },
+                                                    },
+                                                },
+                                            }},
+                                    },
+                                },
+                                "suppressions": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["kind"],
+                                        "properties": {
+                                            "kind": {"enum": [
+                                                "inSource", "external"]},
+                                            "justification": {
+                                                "type": "string"},
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def test_sarif_output_validates_against_2_1_0_schema():
+    """Structural SARIF 2.1.0 conformance, both faces: the clean-tree
+    document (empty results) and a findings-bearing document produced
+    from the seeded-violation fixture corpus."""
+    import jsonschema
+
+    clean = json.loads(_run_pclint("--format", "sarif").stdout)
+    jsonschema.validate(clean, _SARIF_21_SCHEMA)
+
+    dirty_proc = _run_pclint(
+        os.path.join("tests", "lint_fixtures", "env_legacy.py"),
+        "--format", "sarif", "--no-baseline")
+    assert dirty_proc.returncode == 1
+    dirty = json.loads(dirty_proc.stdout)
+    jsonschema.validate(dirty, _SARIF_21_SCHEMA)
+    assert dirty["runs"][0]["results"], "fixture produced no results"
+
+
+_GH_ANNOTATION = re.compile(
+    r"^::error file=(?P<file>[^,]+),line=(?P<line>\d+),"
+    r"col=(?P<col>\d+),title=(?P<title>[^:]+)::(?P<msg>.+)$")
+
+
+def test_github_format_emits_error_annotations():
+    """--format=github: one parseable ::error command per ACTIVE
+    finding, nothing at all on a clean tree (the annotation surface
+    mirrors the exit code)."""
+    clean = _run_pclint("--format", "github")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert clean.stdout.strip() == ""
+
+    dirty = _run_pclint(
+        os.path.join("tests", "lint_fixtures", "env_legacy.py"),
+        "--format", "github", "--no-baseline")
+    assert dirty.returncode == 1
+    lines = dirty.stdout.strip().splitlines()
+    assert lines
+    for ln in lines:
+        m = _GH_ANNOTATION.match(ln)
+        assert m is not None, f"unparseable annotation: {ln!r}"
+        assert int(m.group("line")) >= 1
+        assert int(m.group("col")) >= 1
+        assert m.group("title").startswith("pclint PCL")
+        assert "\n" not in m.group("msg")
 
 
 # ------------------------------------------- PCL013 (cross-module pass)

@@ -160,6 +160,58 @@ def test_tier_registry_and_helpers(monkeypatch):
         assert precision.TIER_NAMES[code] == tier
 
 
+def test_kernel_keyed_rejects_builder_without_kernel_param():
+    """Decoration-time fail-fast: a builder that cannot receive the
+    threaded `kernel` keyword must blow up at import, not with a
+    confusing lru_cache TypeError on first call."""
+    import functools
+
+    with pytest.raises(TypeError, match="`kernel` keyword"):
+        @precision.kernel_keyed
+        @functools.lru_cache(maxsize=4)
+        def _no_kernel_param(n):
+            return n
+
+    # **kwargs can absorb the keyword: accepted
+    @precision.kernel_keyed
+    @functools.lru_cache(maxsize=4)
+    def _kwargs_builder(n, **extra):
+        return n
+
+    assert _kwargs_builder(3) == 3
+
+
+def test_kernel_keyed_threads_resolved_kernel(monkeypatch):
+    """The knob joins the cache key: flipping PYCATKIN_LINALG_KERNEL
+    selects a DIFFERENT cached entry, and an explicit kernel= wins."""
+    import functools
+
+    calls = []
+
+    @precision.kernel_keyed
+    @functools.lru_cache(maxsize=8)
+    def _builder(n, kernel="xla"):
+        calls.append((n, kernel))
+        return (n, kernel)
+
+    monkeypatch.setenv(precision.KERNEL_ENV, "xla")
+    assert _builder(1) == (1, "xla")
+    assert _builder(1) == (1, "xla")          # cache hit, no rebuild
+    assert calls == [(1, "xla")]
+
+    monkeypatch.setenv(precision.KERNEL_ENV, "pallas")
+    assert _builder(1) == (1, "pallas")       # env flip = new entry
+    assert calls == [(1, "xla"), (1, "pallas")]
+
+    assert _builder(1, kernel="xla") == (1, "xla")   # explicit wins
+    assert calls == [(1, "xla"), (1, "pallas")]      # served cached
+
+    # the lru_cache management surface passes through the wrapper
+    assert _builder.cache_info().currsize == 2
+    _builder.cache_clear()
+    assert _builder.cache_info().currsize == 0
+
+
 def test_bulk_options_floors_tolerances():
     """The f32 bulk march must not grind against its own roundoff
     noise: tolerances are floored at the bulk dtype's noise level,

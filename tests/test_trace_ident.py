@@ -1,0 +1,196 @@
+"""pckey dynamic half: the jaxpr trace-identity sanitizer.
+
+The acceptance tripwire (ISSUE 19): two distinct jaxprs forced under
+one program key must raise ``TraceIdentSanError`` AT the compile site
+while the sanitizer is armed (``PYCATKIN_SAN=1`` arms it globally;
+these tests arm it per-test). Knob-duplicate traces are counted, not
+raised. Fingerprints ride along in AOT cache entries and pack
+manifests and are re-verified on import.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import tarfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pycatkin_tpu.parallel import compile_pool
+from pycatkin_tpu.san import TraceIdentSanError, trace_ident
+
+
+@pytest.fixture(autouse=True)
+def armed():
+    trace_ident.reset()
+    trace_ident.activate()
+    yield
+    trace_ident.deactivate()
+    trace_ident.reset()
+
+
+def _f_double(x):
+    return x * 2.0
+
+
+def _f_square(x):
+    return x * x
+
+
+X = jnp.arange(4.0)
+
+
+def test_inactive_is_noop():
+    trace_ident.deactivate()
+    trace_ident.note_jaxpr("k", "key0", _f_double, (X,), force=True)
+    assert trace_ident.stats()["programs"] == 0
+    assert trace_ident.fingerprint_for("key0") is None
+    assert trace_ident.entry_fields("key0") == {}
+
+
+def test_fingerprint_is_stable_and_distinguishes_programs():
+    fp1 = trace_ident.fingerprint(_f_double, (X,))
+    fp2 = trace_ident.fingerprint(_f_double, (X,))
+    fp3 = trace_ident.fingerprint(_f_square, (X,))
+    assert fp1 == fp2
+    assert fp1 != fp3
+    assert len(fp1) == 32 and int(fp1, 16) >= 0
+
+
+def test_injected_collision_raises_at_compile_site():
+    """THE tripwire: one key, two jaxprs, armed sanitizer -> hard error
+    at the second (force=True, i.e. compile-site) observation."""
+    trace_ident.note_jaxpr("steady:a", "keyC", _f_double, (X,),
+                           force=True)
+    with pytest.raises(TraceIdentSanError, match="DIFFERENT jaxpr"):
+        trace_ident.note_jaxpr("steady:a", "keyC", _f_square, (X,),
+                               force=True)
+    st = trace_ident.stats()
+    assert st["collisions"] == 1
+    # the original binding survives the error
+    assert trace_ident.fingerprint_for("keyC") == \
+        trace_ident.fingerprint(_f_double, (X,))
+
+
+def test_same_jaxpr_under_same_key_is_fine():
+    for _ in range(3):
+        trace_ident.note_jaxpr("steady:a", "keyS", _f_double, (X,),
+                               force=True)
+    st = trace_ident.stats()
+    assert st["programs"] == 1 and st["collisions"] == 0
+
+
+def test_seen_key_skips_retrace_unless_forced():
+    trace_ident.note_jaxpr("steady:a", "keyR", fp="a" * 32)
+
+    def _explodes(x):
+        raise RuntimeError("must not be traced on the dispatch seam")
+
+    # dispatch seam (not forced): already-seen key returns untraced
+    trace_ident.note_jaxpr("steady:a", "keyR", _explodes, (X,))
+    assert trace_ident.stats()["trace_failures"] == 0
+    # compile site (forced): retraces; the failure is counted, not
+    # raised -- the sanitizer never takes down a working dispatch
+    trace_ident.note_jaxpr("steady:a", "keyR", _explodes, (X,),
+                           force=True)
+    assert trace_ident.stats()["trace_failures"] == 1
+    assert trace_ident.fingerprint_for("keyR") == "a" * 32
+
+
+def test_knob_duplicates_counted_not_raised():
+    fp = "d" * 32
+    # same stripped base kind, keys differing only in grammar tags
+    trace_ident.note_jaxpr("steady:opts:cpu", "keyA", fp=fp)
+    trace_ident.note_jaxpr("steady:opts:cpu:p32", "keyB", fp=fp)
+    # same fingerprint but a genuinely different base kind: not bloat
+    trace_ident.note_jaxpr("jac:other", "keyD", fp=fp)
+    groups = trace_ident.duplicate_groups()
+    assert len(groups) == 1
+    st = trace_ident.stats()
+    assert st["collisions"] == 0
+    assert st["duplicate_groups"] == 1
+    assert st["duplicate_keys"] == 3
+    assert st["programs"] == 3 and st["fingerprints"] == 1
+
+
+def test_entry_fields_round_trip():
+    trace_ident.note_jaxpr("steady:a:p32", "keyE", _f_double, (X,),
+                           force=True)
+    fields = trace_ident.entry_fields("keyE")
+    assert fields == {
+        "trace_ident": trace_ident.fingerprint(_f_double, (X,)),
+        "kind": "steady:a:p32",
+    }
+
+
+def _saved_cache(tmp_path):
+    """A one-entry AOT cache written while the sanitizer was armed."""
+    f = jax.jit(_f_double)
+    compiled = f.lower(X).compile()
+    key = compile_pool.program_key("test:ident", (X,))
+    trace_ident.note_jaxpr("test:ident", key, _f_double, (X,),
+                           force=True)
+    cache = compile_pool.AOTCache(root=str(tmp_path / "aot"),
+                                  fingerprint="fp0")
+    assert cache.save(key, compiled)
+    return key, cache
+
+
+def test_aot_entry_carries_trace_ident(tmp_path):
+    key, cache = _saved_cache(tmp_path)
+    with open(cache._path(key), "rb") as fh:
+        entry = pickle.load(fh)
+    assert entry["trace_ident"] == trace_ident.fingerprint_for(key)
+    assert entry["kind"] == "test:ident"
+
+
+def test_pack_manifest_carries_and_import_verifies(tmp_path):
+    key, cache = _saved_cache(tmp_path)
+    pack = str(tmp_path / "pack.tgz")
+    compile_pool.export_cache_pack(pack, cache_root=cache.root)
+    with tarfile.open(pack, "r:gz") as tf:
+        manifest = json.load(tf.extractfile("manifest.json"))
+    meta = manifest["entries"][key]
+    assert meta["trace_ident"] == trace_ident.fingerprint_for(key)
+    assert meta["kind"] == "test:ident"
+
+    # clean import replays the fingerprint through the sanitizer: OK
+    stats = compile_pool.import_cache_pack(
+        pack, cache_root=str(tmp_path / "in1"))
+    assert stats["imported"] == 1
+    assert trace_ident.stats()["collisions"] == 0
+
+    # a pack whose fingerprint contradicts the locally-observed trace
+    # for the same key must trip the sanitizer on import
+    trace_ident.reset()
+    trace_ident.note_jaxpr("test:ident", key, _f_square, (X,),
+                           force=True)
+    with pytest.raises(TraceIdentSanError):
+        compile_pool.import_cache_pack(
+            pack, cache_root=str(tmp_path / "in2"))
+
+
+def test_install_arms_trace_ident(monkeypatch):
+    import pycatkin_tpu.san as san
+
+    trace_ident.deactivate()
+    monkeypatch.setenv("PYCATKIN_SAN", "1")
+    san.install()
+    assert trace_ident.is_active()
+
+
+@pytest.mark.slow
+def test_real_sweep_records_no_collisions():
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.parallel import batch
+
+    sim = synthetic_system(n_species=8, n_reactions=10)
+    conds = batch.broadcast_conditions(sim.conditions(), 4)
+    batch.sweep_steady_state(sim.spec, conds)
+    st = trace_ident.stats()
+    assert st["programs"] >= 1
+    assert st["collisions"] == 0
+    assert st["trace_failures"] == 0

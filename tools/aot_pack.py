@@ -54,6 +54,7 @@ def _cmd_import(args) -> int:
 
 
 def _cmd_selftest(args) -> int:
+    import tarfile
     import tempfile
 
     import numpy as np
@@ -65,6 +66,14 @@ def _cmd_selftest(args) -> int:
                                              clear_program_caches,
                                              prewarm_sweep_programs,
                                              sweep_steady_state)
+    from pycatkin_tpu.san import trace_ident
+
+    # Armed for the whole round trip (pckey): every prewarmed program
+    # is jaxpr-fingerprinted, the exported manifest must carry those
+    # fingerprints, and the import replays them -- a key bound to two
+    # distinct traces anywhere in the loop raises TraceIdentSanError.
+    trace_ident.reset()
+    trace_ident.activate()
 
     sim = synthetic_system(n_species=16, n_reactions=24, seed=3)
     spec = sim.spec
@@ -93,6 +102,18 @@ def _cmd_selftest(args) -> int:
         exported = compile_pool.export_cache_pack(pack, cache_root=root_a)
         print(f"selftest: exported {exported['entries']} entries "
               f"({exported['bytes']} bytes)")
+        with tarfile.open(pack, "r:gz") as tar:
+            manifest = json.load(
+                tar.extractfile(compile_pool.PACK_MANIFEST))
+        unfingerprinted = [
+            k for k, m in manifest["entries"].items()
+            if not m.get("trace_ident")
+            or m["trace_ident"] != trace_ident.fingerprint_for(k)]
+        if unfingerprinted:
+            print("selftest: FAIL -- pack entries missing (or "
+                  "contradicting) their jaxpr fingerprint: "
+                  f"{unfingerprinted}")
+            return 1
         imported = compile_pool.import_cache_pack(pack, cache_root=root_b)
         if imported["imported"] != exported["entries"]:
             print("selftest: FAIL -- import lost entries "

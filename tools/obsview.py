@@ -93,10 +93,10 @@ def selftest(sweep: bool = False) -> int:
 
     # 3. Prometheus exposition lint on a populated scratch registry.
     reg = MetricsRegistry()
-    reg.counter("obsview_selftest_total", "selftest counter").inc(
+    reg.counter("obsview_selftest_total", "selftest counter").inc(  # pclint: disable=PCL009 -- scratch-registry selftest fixture, never exported to production /metrics
         3, kind="demo")
-    reg.gauge("obsview_selftest_gauge").set(1.5)
-    h = reg.histogram("obsview_selftest_seconds", "selftest histogram")
+    reg.gauge("obsview_selftest_gauge").set(1.5)  # pclint: disable=PCL009 -- scratch-registry selftest fixture, never exported to production /metrics
+    h = reg.histogram("obsview_selftest_seconds", "selftest histogram")  # pclint: disable=PCL009 -- scratch-registry selftest fixture, never exported to production /metrics
     for v in (0.004, 0.2, 7.0):
         h.observe(v)
     problems = validate_prometheus_text(reg.prometheus_text())
