@@ -64,6 +64,12 @@
 # cache-key-completeness + PCL015 key-tag-discipline rules over the
 # tree, their mutation-tripwire fixture tests, and the trace-ident
 # jaxpr-fingerprint sanitizer suite run armed (PYCATKIN_SAN=1).
+# `transient-check` is the fused dense-output transient lane
+# (docs/perf_transient.md), run with the pcsan tripwires armed: the
+# fused/chunked + packed/solo bitwise equivalence suite plus the
+# transient sync-budget pins, then a quick --transient bench gating on
+# the >=3x fused speedup, the 1-materialization budget and
+# bit-identical fused-vs-chunked output.
 
 PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	--continue-on-collection-errors -p no:cacheprovider
@@ -71,7 +77,8 @@ PYTEST = env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 .PHONY: test test-faults test-validate test-sharded test-san test-all \
 	lint lint-faults lint-syncs lint-baseline bench-smoke \
 	aot-pack-selftest obs-check perfwatch chaos serve-check \
-	router-check durable-check kernels-check keys-check
+	router-check durable-check kernels-check keys-check \
+	transient-check
 
 test:
 	$(PYTEST) -m 'not slow'
@@ -130,6 +137,15 @@ keys-check:
 	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python -m pytest \
 		tests/test_pckey_lint.py tests/test_trace_ident.py -q \
 		-p no:cacheprovider
+
+transient-check:
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python -m pytest \
+		tests/test_transient_fused.py \
+		"tests/test_sync_budget.py::test_fused_clean_transient_spends_one_sync" \
+		"tests/test_sync_budget.py::test_packed_clean_transient_spends_one_sync_regardless_of_k" \
+		-q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu PYCATKIN_SAN=1 python bench.py \
+		--transient --quick --gate
 
 aot-pack-selftest:
 	env JAX_PLATFORMS=cpu python tools/aot_pack.py selftest

@@ -1535,6 +1535,160 @@ def linalg_main(argv):
     return 0
 
 
+def transient_main(argv):
+    """``bench.py --transient``: the fused dense-output transient lane
+    (docs/perf_transient.md). Times the fused single-dispatch sweep
+    (``batch_transient`` with PYCATKIN_FUSED_TRANSIENT on) against the
+    host-driven chunk loop it replaces (same programs, forced
+    multi-chunk as on a watchdogged TPU runtime), checks the endpoints
+    bitwise-identical, pins the fused sync budget (exactly one counted
+    sync, the ``fused transient bundle`` pull) and counts save-buffer
+    materializations through the obs counter. Prints exactly one JSON
+    line; the ``transient`` sub-object (``transient_pts_per_s``) feeds
+    the perfwatch history. ``--gate`` additionally requires the >= 3x
+    fused-over-chunked wall ratio the design targets; ``--quick``
+    shrinks the grid for CI."""
+    import jax.numpy as jnp
+
+    from pycatkin_tpu import engine
+    from pycatkin_tpu.models.synthetic import synthetic_system
+    from pycatkin_tpu.obs import metrics as _metrics
+    from pycatkin_tpu.parallel import batch as _batch
+    from pycatkin_tpu.utils import profiling
+
+    quick = "--quick" in argv
+    gate = "--gate" in argv
+    lanes = int(os.environ.get("BENCH_TRANSIENT_LANES", "2"))
+    n_pts = int(os.environ.get("BENCH_TRANSIENT_PTS",
+                               "513" if quick else "2049"))
+    chunk = int(os.environ.get("BENCH_TRANSIENT_CHUNK", "1"))
+    trials = 2 if quick else 3
+
+    # The dense-output workload the fused scan targets (ROADMAP item
+    # 4's surrogate-teacher use): a uniform fine-resolution save grid
+    # where each point costs about one integrator step, so the host
+    # drive pays one dispatch + one blocking pull PER POINT (chunk=1,
+    # the reference implementation's solve-loop pattern) while the
+    # fused program amortizes the whole grid into one dispatch. h0 is
+    # matched to the grid spacing so neither path burns steps ramping
+    # up from the default 1e-10.
+    sim = synthetic_system(n_species=12, n_reactions=14, seed=7)
+    spec = sim.spec
+    conds = _batch.broadcast_conditions(sim.conditions(), lanes)
+    conds = conds._replace(T=np.linspace(480.0, 560.0, lanes))
+    save_ts = np.linspace(0.0, (n_pts - 1) * 1.0e-8, n_pts)
+    opts = engine.ODEOptions(h0=1.0e-8)
+
+    def _mat_count():
+        vals = _metrics.counter(
+            "pycatkin_transient_materializations_total").values()
+        return float(sum(vals.values()))
+
+    def run_chunked():
+        # The production fallback path exactly as a TPU runtime would
+        # drive it: bounded chunks, one device call + one blocking
+        # pull per chunk (force_chunking skips the off-TPU collapse
+        # to a single chunk so the baseline is honest about the host
+        # round-trips the fused path deletes).
+        cprog = _batch._transient_chunk_program(
+            _batch._prog_spec(spec), opts)
+        fprog = _batch._transient_finish_program(
+            _batch._prog_spec(spec), engine.finish_options(opts))
+        return engine.chunked_transient_drive(
+            cprog, fprog, conds,
+            jnp.asarray(conds.y0, dtype=jnp.float64), save_ts, opts,
+            chunk, batched=True, force_chunking=True)
+
+    def run_fused():
+        return _batch.batch_transient(spec, conds, save_ts, opts=opts)
+
+    failures = []
+    prev_env = os.environ.get(engine.FUSED_TRANSIENT_ENV)
+    os.environ[engine.FUSED_TRANSIENT_ENV] = "1"
+    try:
+        # Warm both paths (compiles excluded from the timed trials).
+        ys_f, ok_f = run_fused()
+        ys_c, ok_c = run_chunked()
+
+        for name, a, b in (("ys", ys_f, ys_c), ("ok", ok_f, ok_c)):
+            a, b = np.asarray(a), np.asarray(b)
+            if (a.dtype != b.dtype or a.shape != b.shape
+                    or a.tobytes() != b.tobytes()):
+                failures.append(f"fused {name} != chunked {name} "
+                                f"(bitwise)")
+
+        m0 = _mat_count()
+        profiling.reset_sync_count()
+        fused_walls = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            with profiling.sync_budget() as budget:
+                run_fused()
+            fused_walls.append(time.perf_counter() - t0)
+            if (budget.count != 1
+                    or budget.labels != ["fused transient bundle"]):
+                failures.append(
+                    f"fused sweep spent {budget.count} counted "
+                    f"sync(s) {budget.labels} (contract: exactly 1, "
+                    f"the bundle pull)")
+        fused_mat = _mat_count() - m0
+
+        m0 = _mat_count()
+        chunked_walls = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            run_chunked()
+            chunked_walls.append(time.perf_counter() - t0)
+        chunked_mat = _mat_count() - m0
+    finally:
+        if prev_env is None:
+            os.environ.pop(engine.FUSED_TRANSIENT_ENV, None)
+        else:
+            os.environ[engine.FUSED_TRANSIENT_ENV] = prev_env
+
+    fused_s = float(np.median(fused_walls))
+    chunked_s = float(np.median(chunked_walls))
+    speedup = chunked_s / fused_s if fused_s > 0 else float("inf")
+    pts_per_s = lanes * len(save_ts) / fused_s
+    if fused_mat != trials:
+        failures.append(f"fused path materialized {fused_mat:.0f} "
+                        f"buffers over {trials} sweeps (contract: 1 "
+                        f"per sweep)")
+    if gate and speedup < 3.0:
+        failures.append(f"fused speedup {speedup:.2f}x < 3x gate "
+                        f"(fused {fused_s:.4f}s vs chunked "
+                        f"{chunked_s:.4f}s)")
+
+    import jax
+    result = {
+        "metric": "transient sweep",
+        "backend": jax.devices()[0].platform,
+        "unit": "save points per second (fused, whole sweep)",
+        "interpret": jax.default_backend() != "tpu",
+        "lanes": lanes, "save_points": len(save_ts),
+        "chunk": chunk, "trials": trials,
+        "fused_wall_s": round(fused_s, 4),
+        "chunked_wall_s": round(chunked_s, 4),
+        "speedup": round(speedup, 3),
+        "materializations": {"fused_per_sweep": fused_mat / trials,
+                             "chunked_per_sweep":
+                                 chunked_mat / trials},
+        "bitwise_identical": not any("bitwise" in f
+                                     for f in failures),
+        "failures": failures,
+        "transient": {"transient_pts_per_s": round(pts_per_s, 1)},
+    }
+    print(json.dumps(result))
+    if failures:
+        for f in failures:
+            log(f"bench-transient: FAIL -- {f}")
+        return 1
+    log(f"bench-transient: OK -- {pts_per_s:.0f} pts/s fused, "
+        f"{speedup:.2f}x over the chunked loop "
+        f"({chunked_mat / trials:.0f} materializations -> 1)")
+    return 0
+
+
 def journal_main(argv):
     """Durable chunked sweep with checkpoint/resume (--journal mode)
     and/or per-lane failure forensics (--forensics).
@@ -1678,14 +1832,17 @@ def _prior_round_value():
 if __name__ == "__main__":
     # No arguments: the historical timing benchmark, exactly one JSON
     # line. --smoke is the CI canary; --linalg the direction-kernel
-    # microbench lane; any other argument switches to the journaled
-    # chunked mode. --trace DIR composes with every mode (stripped
-    # here so the routing below never sees it).
+    # microbench lane; --transient the fused dense-output lane; any
+    # other argument switches to the journaled chunked mode. --trace
+    # DIR composes with every mode (stripped here so the routing below
+    # never sees it).
     TRACE_DIR = _strip_trace_arg(sys.argv)
     if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         sys.exit(smoke_main())
     elif len(sys.argv) > 1 and sys.argv[1] == "--linalg":
         sys.exit(linalg_main(sys.argv[1:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--transient":
+        sys.exit(transient_main(sys.argv[1:]))
     elif len(sys.argv) > 1:
         journal_main(sys.argv[1:])
     else:

@@ -21,6 +21,7 @@ descriptor energies, noise or rate multipliers are ``jax.vmap`` axes.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache as _lru_cache, partial
 from typing import NamedTuple
 
@@ -31,6 +32,8 @@ import numpy as np
 from . import precision as _precision
 from .constants import (JtoeV, LOG_H_OVER_KB, R, bartoPa, eVtokJ, h, kB)
 from .frontend.spec import REACTOR_CSTR, REACTOR_ID, Conditions, ModelSpec
+from .lint.hotpath import hotpath
+from .obs import metrics as _metrics
 from .ops import linalg, network, rates, thermo
 from .solvers import newton
 from .solvers.newton import SolverOptions, SteadyStateResults
@@ -370,6 +373,7 @@ def check_stability(spec: ModelSpec, cond: Conditions, y_full,
     return newton.jacobian_eigenvalues_stable(J, pos_tol)
 
 
+@hotpath
 def _transient_closures(spec: ModelSpec, cond: Conditions,
                         steady_rel: float = ODEOptions().steady_rel):
     """(rhs, jac, steady_fn, relax_fn) for the transient integrator.
@@ -394,7 +398,7 @@ def _transient_closures(spec: ModelSpec, cond: Conditions,
     keeps evolving, so real sub-verdict drift still completes."""
     rhs, rhs_and_scale = make_rhs_and_scale(spec, cond)
     jac = jax.jacfwd(rhs)
-    floor = 8.0 * float(jnp.finfo(jnp.float64).eps)
+    floor = 8.0 * float(jnp.finfo(jnp.float64).eps)  # sync-ok: finfo is a host constant, no device value pulled
     verdict_rel = steady_rel
 
     def steady_fn(y):
@@ -495,8 +499,36 @@ def finish_options(opts: ODEOptions) -> SolverOptions:
     return SolverOptions(rate_tol_rel=opts.steady_rel)
 
 
+FUSED_TRANSIENT_ENV = "PYCATKIN_FUSED_TRANSIENT"
+
+
+def fused_transient_enabled() -> bool:
+    """Route transients through the fused single-dispatch scan program
+    (``parallel.batch._fused_batch_transient``)? Mirrors the steady
+    sweeps' ``PYCATKIN_FUSED_SWEEP`` gate: default on, disabled by
+    ``PYCATKIN_FUSED_TRANSIENT=0`` or under an active fault plan --
+    the fault-injection sites (chunk boundaries, finish) live on the
+    host-driven path, so drills must keep exercising it."""
+    from .robustness.faults import active_plan
+    if active_plan() is not None:
+        return False
+    return os.environ.get(FUSED_TRANSIENT_ENV, "1").strip().lower() not in (
+        "0", "off", "none", "disabled", "false")
+
+
+def _transient_materialized(n: int) -> None:
+    """Count dense-output materializations (blocking device->host pulls
+    of transient save buffers). The chunked drive pays one per chunk
+    plus the finish; the fused path pays exactly one bundle."""
+    _metrics.counter(
+        "pycatkin_transient_materializations_total",
+        "blocking transient save-buffer materializations").inc(n)
+
+
+@hotpath
 def chunked_transient_drive(step, finish, conds, y0, save_ts,
-                            opts: ODEOptions, chunk: int, batched: bool):
+                            opts: ODEOptions, chunk: int, batched: bool,
+                            force_chunking: bool = False):
     """Shared host-side chunking protocol for single-lane AND batched
     transients: process the save grid in fixed-size chunks, each a
     bounded jitted device call (padding the last chunk with repeats of
@@ -504,18 +536,20 @@ def chunked_transient_drive(step, finish, conds, y0, save_ts,
     under shared-runtime execution watchdogs; then apply the Newton
     finish to the endpoint. ``step(conds, state, part)`` and
     ``finish(conds, y_last, ok)`` are the (possibly vmapped) compiled
-    programs; ``batched`` says whether arrays carry a leading lane axis.
-    Returns (ys, ok)."""
-    save_ts = np.asarray(save_ts)
-    if jax.default_backend() != "tpu":
+    programs; ``batched`` says whether arrays carry a leading lane axis;
+    ``force_chunking`` keeps the real multi-chunk loop even off-TPU
+    (the bench baseline measures the per-chunk dispatch cost the fused
+    path removes). Returns (ys, ok)."""
+    save_ts = np.asarray(save_ts)  # sync-ok: host-provided save grid
+    if jax.default_backend() != "tpu" and not force_chunking:
         # No execution watchdog off-TPU: one call minimizes dispatch.
         chunk = max(chunk, len(save_ts))
     if batched:
         state = jax.vmap(lambda y: ode_init_state(y, save_ts[0], opts))(y0)
-        blocks = [np.asarray(y0)[:, None, :]]
+        blocks = [np.asarray(y0)[:, None, :]]  # sync-ok: y0 is host input
     else:
         state = ode_init_state(y0, save_ts[0], opts)
-        blocks = [np.asarray(y0)[None, :]]
+        blocks = [np.asarray(y0)[None, :]]  # sync-ok: y0 is host input
     ts = save_ts[1:]
     for i in range(0, len(ts), chunk):
         part = ts[i:i + chunk]
@@ -524,6 +558,7 @@ def chunked_transient_drive(step, finish, conds, y0, save_ts,
             part = np.concatenate([part, np.full(npad, ts[-1])])
         state, ys_chunk = step(conds, state, jnp.asarray(part))
         ys_np = host_sync(ys_chunk, f"transient chunk[{i // chunk}]")
+        _transient_materialized(1)
         if npad:
             ys_np = ys_np[:, :chunk - npad] if batched else \
                 ys_np[:chunk - npad]
@@ -535,6 +570,7 @@ def chunked_transient_drive(step, finish, conds, y0, save_ts,
         ys[:, -1] = host_sync(y_fin, "transient finish")
     else:
         ys[-1] = host_sync(y_fin, "transient finish")
+    _transient_materialized(1)
     return jnp.asarray(ys), ok
 
 

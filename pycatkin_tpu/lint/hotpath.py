@@ -48,7 +48,8 @@ SYNC_ANNOTATION = "# sync-ok:"
 # paths). A decorated function in an UNLISTED file is invisible to the
 # static side, so PCL013's drift test also asserts the runtime registry
 # (populated at import) stays inside this file set.
-HOT_PATH_SCAN_FILES = ("pycatkin_tpu/parallel/batch.py",
+HOT_PATH_SCAN_FILES = ("pycatkin_tpu/engine.py",
+                       "pycatkin_tpu/parallel/batch.py",
                        "pycatkin_tpu/ops/pallas_linalg.py")
 
 # Runtime half of the registry: (module, qualname) of every function

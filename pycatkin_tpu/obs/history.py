@@ -53,6 +53,12 @@ TRACKED_METRICS = {
     "linalg_mfu_32": "higher",
     "linalg_mfu_128": "higher",
     "linalg_mfu_512": "higher",
+    # Fused transient lane (bench.py --transient; pulled from the
+    # record's "transient" sub-object): whole-sweep dense-output
+    # throughput of the fused single-dispatch path
+    # (docs/perf_transient.md), baselined per backend like everything
+    # else.
+    "transient_pts_per_s": "higher",
 }
 
 # A regression must clear BOTH gates: beyond ``mad_k`` median absolute
@@ -98,7 +104,8 @@ def extract_metrics(record: dict) -> dict:
     ``journal_replay_s`` to its ``durable`` sub-object, and
     ``linalg_mfu_<bucket>`` to the ``linalg`` sub-object a
     ``bench.py --linalg`` record nests them under (as
-    ``mfu_<bucket>``)."""
+    ``mfu_<bucket>``), and ``transient_pts_per_s`` to the
+    ``transient`` sub-object of a ``bench.py --transient`` record."""
     rec = _unwrap(record)
     serve = rec.get("serve") if isinstance(rec.get("serve"),
                                            dict) else {}
@@ -108,6 +115,8 @@ def extract_metrics(record: dict) -> dict:
                                                dict) else {}
     linalg = rec.get("linalg") if isinstance(rec.get("linalg"),
                                              dict) else {}
+    transient = rec.get("transient") if isinstance(
+        rec.get("transient"), dict) else {}
     out = {}
     for key in TRACKED_METRICS:
         v = rec.get(key)
@@ -125,6 +134,8 @@ def extract_metrics(record: dict) -> dict:
             v = durable.get(key)
         if v is None and key.startswith("linalg_"):
             v = linalg.get(key[len("linalg_"):])
+        if v is None and key == "transient_pts_per_s":
+            v = transient.get("transient_pts_per_s")
         try:
             f = float(v)
         except (TypeError, ValueError):

@@ -81,6 +81,8 @@ def clear_program_caches():
     _rescue_program.cache_clear()
     _transient_chunk_program.cache_clear()
     _transient_finish_program.cache_clear()
+    _fused_transient_program.cache_clear()
+    _packed_transient_program.cache_clear()
     _tof_program.cache_clear()
     _jacobian_program.cache_clear()
     _stability_screen_program.cache_clear()
@@ -418,6 +420,138 @@ def _transient_finish_program(spec: ModelSpec, sopts: SolverOptions,
     return jax.jit(jax.vmap(fin_one))
 
 
+# ---------------------------------------------------------------------
+# Fused transient sweeps (docs/perf_transient.md): the whole save grid
+# scanned inside ONE traced program (solvers/ode.integrate's lax.scan
+# assembles the dense-output buffer on device, XLA aliases the scan
+# carry), Newton finish and per-lane verdict packing fused in, so a
+# clean transient sweep costs ONE dispatch and ONE counted host sync
+# instead of the chunked drive's one-per-chunk. The host-driven chunk
+# loop survives behind PYCATKIN_FUSED_TRANSIENT=0 and under active
+# fault plans (engine.fused_transient_enabled), bit-identical.
+def _ftrans_kind(opts: ODEOptions, backend: str, sharding=None) -> str:
+    """Registry/cache kind string for the fused transient program
+    (scan-chunked integration + Newton finish + packed diagnostics in
+    ONE dispatch). Transients always run the full-f64 path (no tier
+    tag -- the chunk/finish programs never resolve the tier either,
+    PCL014), but the implicit ODE stages embed make_msolve direction
+    solves, so the kernel tag applies."""
+    return (f"ftrans:{opts!r}:{backend}"
+            f"{_precision.kernel_tag()}{_sharding_tag(sharding)}")
+
+
+def _packed_ftrans_kind(opts: ODEOptions, backend: str,
+                        k_bucket: int) -> str:
+    """Packed multi-tenant transient kind: the solo fused-transient
+    kind plus the tenant-count pow2 sub-bucket tag, composed LAST so a
+    ``k_bucket`` of 1 reproduces the solo kind byte-for-byte."""
+    return (_ftrans_kind(opts, backend, None)
+            + compile_pool.tenant_tag(k_bucket))
+
+
+def _abi_transient_body(spec, opts: ODEOptions):
+    """Module-level fused transient body over ONE tenant's inputs:
+    ``program(ops, conds, save_ts) -> (ys, ok, bundle)``. Shared
+    verbatim by the solo fused program and the packed multi-tenant
+    program (which vmaps it over the tenant axis), so the per-tenant
+    math is the SAME trace either way -- the packed bit-identity
+    contract, exactly like :func:`_abi_fused_body`."""
+    from ..solvers.newton import packed_sweep_diagnostics
+
+    def program(ops, conds, save_ts):
+        tspec = spec.bind(ops)
+
+        def run_one(cond):
+            return engine.transient(tspec, cond, save_ts, opts)
+
+        ys, ok = jax.vmap(run_one)(conds)
+        # Lanes whose endpoint is non-finite (NaN-poisoned inputs,
+        # genuinely diverged integrations) are counted as quarantined
+        # in the bundle; isolation is structural -- vmap lanes (and
+        # stacked tenants) never mix values.
+        finite = jnp.all(jnp.isfinite(ys[:, -1, :]), axis=-1)
+        return ys, ok, packed_sweep_diagnostics(ok & finite, ~finite)
+
+    return program
+
+
+@_precision.kernel_keyed
+@lru_cache(maxsize=16)
+def _fused_transient_program(spec: ModelSpec, opts: ODEOptions,
+                             kernel: str = "xla"):
+    # ``kernel`` is a cache key only (kernel_keyed), exactly like the
+    # chunk/finish programs: no tier knob reaches the transient trace.
+    from ..solvers.newton import packed_sweep_diagnostics
+    if isinstance(spec, _abi.AbiProgramSpec):
+        return jax.jit(_abi_transient_body(spec, opts))
+
+    def program(conds, save_ts):
+        def run_one(cond):
+            return engine.transient(spec, cond, save_ts, opts)
+        ys, ok = jax.vmap(run_one)(conds)
+        finite = jnp.all(jnp.isfinite(ys[:, -1, :]), axis=-1)
+        return ys, ok, packed_sweep_diagnostics(ok & finite, ~finite)
+
+    return jax.jit(program)
+
+
+@_precision.kernel_keyed
+@lru_cache(maxsize=16)
+def _packed_transient_program(spec, opts: ODEOptions,
+                              kernel: str = "xla"):
+    """K tenants' fused transient bodies under ONE ``jax.vmap`` over
+    the stacked operand/condition pytrees (the save grid is shared:
+    the request coalescer groups transient requests by grid). The body
+    is the module-level :func:`_abi_transient_body` -- the same trace
+    as the solo program, which is what makes per-tenant results
+    bitwise equal to solo runs. The REAL tenant count is not a cache
+    key (vmap adapts to the leading axis length), so every k in a pow2
+    sub-bucket shares one program."""
+    return jax.jit(jax.vmap(_abi_transient_body(spec, opts),
+                            in_axes=(0, 0, None)))
+
+
+@hotpath
+def _fused_batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
+                           opts: ODEOptions):
+    """The fused-dispatch transient sweep: one device program scans the
+    whole save grid (dense output assembled on device), applies the
+    Newton finish and packs the per-lane verdicts; ONE counted host
+    sync pulls (ys, ok, bundle) as a single batched transfer. Returns
+    (ys [lanes, t, n_s], ok [lanes])."""
+    n_lanes = jax.tree_util.tree_leaves(conds)[0].shape[0]
+    backend = _resolve_backend()
+    prog = _fused_transient_program(_prog_spec(spec), opts)
+    kind = _ftrans_kind(opts, backend)
+    ts = jnp.asarray(save_ts, dtype=jnp.float64)
+
+    def run_fused():
+        args = (conds, ts)
+        fkey = compile_pool.program_key(kind, _prog_args(spec, args))
+        out = _registered_call(spec, kind, prog, args)
+        t0 = _time_mod.perf_counter()
+        # The ONE materialization: dense output + ok + diagnostics as
+        # a single batched device_get, inside the retried unit; its
+        # blocked wall folds onto the fused program's ledger row
+        # (count=0: _registered_call already counted the dispatch).
+        ys, ok, bundle = host_sync(out, "fused transient bundle")
+        _costs.note_dispatch(fkey, _time_mod.perf_counter() - t0,
+                             count=0)
+        return ys, ok, bundle
+
+    with span("fused transient sweep", lanes=n_lanes,
+              save_pts=len(save_ts)):
+        ys, ok, bundle = call_with_backend_retry(
+            run_fused, label="batched transient sweep")
+    engine._transient_materialized(1)
+    n_quar = int(bundle[1])
+    if n_quar:
+        record_event("degradation", label="transient:nonfinite",
+                     detail="transient lanes with non-finite "
+                            "endpoints", lanes=n_quar)
+    return jnp.asarray(ys), jnp.asarray(ok)
+
+
 def _warn_negative_tof(neg):
     neg = int(neg)
     if neg:
@@ -597,6 +731,15 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
                                  opts=opts, mesh=mesh, chunk=chunk)
         return low.unpad_y(ys), ok
 
+    if mesh is None and engine.fused_transient_enabled():
+        # Fused one-dispatch path (docs/perf_transient.md): the scan
+        # over the save grid runs inside one traced program instead of
+        # the host chunk loop below -- bit-identical output, one
+        # counted sync. Disabled by PYCATKIN_FUSED_TRANSIENT=0 and
+        # under active fault plans (the fault sites live on the
+        # chunked path).
+        return _fused_batch_transient(spec, conds, save_ts, opts)
+
     n = None
     if mesh is not None:
         n_dev = mesh.devices.size
@@ -620,6 +763,192 @@ def batch_transient(spec: ModelSpec, conds: Conditions, save_ts,
     if n is not None:
         return ys[:n], ok[:n]
     return ys, ok
+
+
+@hotpath
+def packed_batch_transient(specs, conds, save_ts,
+                           opts: ODEOptions = ODEOptions(),
+                           chunk: int = 8) -> list:
+    """Multi-tenant :func:`batch_transient`: K mechanisms that lower
+    into ONE ABI bucket integrate as one packed device dispatch (one
+    host sync, one AOT executable, zero marginal compiles in a warm
+    bucket) and return a LIST of per-tenant ``(ys, ok)`` pairs, each
+    bitwise identical to that mechanism's solo ``batch_transient``
+    call. The save grid is shared across tenants (the request
+    coalescer groups transient requests by grid, so its packs satisfy
+    this by construction).
+
+    Degradations mirror :func:`packed_sweep_steady_state`: a single
+    tenant, the ABI gate off / no bucket fit, or the fused transient
+    disabled (``PYCATKIN_FUSED_TRANSIENT=0``, active fault plan) fall
+    back to per-tenant solo runs with a ``degradation`` event;
+    cross-bucket tenants raise :class:`frontend.abi.AbiBucketError`."""
+    specs = list(specs)
+    k = len(specs)
+    if k == 0:
+        return []
+
+    def _per_tenant(v, name):
+        vs = (list(v) if isinstance(v, (list, tuple)) else [v] * k)
+        if len(vs) != k:
+            raise ValueError(f"{name}: {len(vs)} entries for {k} "
+                             f"tenants")
+        return vs
+
+    conds_list = _per_tenant(conds, "conds")
+
+    def _solo():
+        return [batch_transient(s, c, save_ts, opts=opts, chunk=chunk)
+                for s, c in zip(specs, conds_list)]
+
+    if k == 1:
+        # Degenerate pack: the solo path, so program keys/caches stay
+        # byte-identical to the solo world (:tK contract).
+        return _solo()
+    lows = [s if isinstance(s, _abi.AbiLowered) else _abi.maybe_lower(s)
+            for s in specs]
+    if any(low is None for low in lows) or \
+            not engine.fused_transient_enabled():
+        record_event("degradation", label="packed:solo-fallback",
+                     detail="ABI lowering or the fused transient is "
+                            "unavailable; running tenants as solo "
+                            "transients", tenants=k)
+        _metrics.counter(
+            "pycatkin_packed_solo_fallbacks_total",
+            "packed sweep requests degraded to per-tenant solo "
+            "sweeps").inc()
+        return _solo()
+    pack = _abi.pack_lowered(lows)
+    lanes = [jax.tree_util.tree_leaves(c)[0].shape[0]
+             for c in conds_list]
+    if len(set(lanes)) != 1:
+        raise ValueError(f"packed tenants must share a lane count, "
+                         f"got {lanes}")
+    n_lanes = lanes[0]
+    kb = pack.k_bucket
+    backend = _resolve_backend()
+    _metrics.counter(
+        "pycatkin_packed_transient_sweeps_total",
+        "packed multi-tenant transient dispatches per tenant "
+        "sub-bucket").inc(bucket=pack.abi_fingerprint)
+    conds_st = pack.stack_tenants(
+        [low.pad_conditions(c) for low, c in zip(lows, conds_list)])
+    prog = _packed_transient_program(pack.program_spec, opts)
+    kind = _packed_ftrans_kind(opts, backend, kb)
+    ts = jnp.asarray(save_ts, dtype=jnp.float64)
+
+    def run_packed():
+        args = (conds_st, ts)
+        fkey = compile_pool.program_key(kind, _prog_args(pack, args))
+        _costs.record(fkey, kind=kind,
+                      label=f"packed transient @{n_lanes}"
+                            f" x{pack.k}/{kb}")
+        out = _registered_call(pack, kind, prog, args)
+        t0 = _time_mod.perf_counter()
+        ys, ok, bundle = host_sync(out, "packed transient bundle")
+        _costs.note_dispatch(fkey, _time_mod.perf_counter() - t0,
+                             count=0)
+        return ys, ok, bundle
+
+    with span("packed transient sweep", tenants=pack.k, k_bucket=kb,
+              lanes=n_lanes):
+        ys, ok, bundle = call_with_backend_retry(
+            run_packed, label="packed batched transient")
+    engine._transient_materialized(1)
+    n_quar = int(np.sum(bundle[:pack.k, 1]))
+    if n_quar:
+        record_event("degradation", label="transient:nonfinite",
+                     detail="transient lanes with non-finite "
+                            "endpoints", lanes=n_quar)
+    return [(low.unpad_y(jnp.asarray(ys[i])), jnp.asarray(ok[i]))
+            for i, low in enumerate(pack.tenants)]
+
+
+def prewarm_transient_programs(spec, conds, save_ts,
+                               opts: ODEOptions = ODEOptions(),
+                               k_buckets=(), cache=None):
+    """Load-or-compile the fused transient executables a
+    :func:`batch_transient` / :func:`packed_batch_transient` call over
+    these inputs would dispatch (registry + AOT cache, no execution):
+    the solo fused program, plus one packed program per tenant bucket
+    in ``k_buckets``. Transient programs key on the save-grid LENGTH
+    (shape), not its values, so warming with any same-length grid
+    covers every request on that grid size. No-op (empty stats) when
+    the fused transient is disabled -- the chunked fallback's
+    chunk/finish programs compile lazily per chunk shape and are not
+    AOT-managed. Returns :class:`PrewarmStats`."""
+    stats = PrewarmStats(0)
+    stats.compiled = stats.loaded = stats.executed = 0
+    stats.cache_writes = 0
+    stats.cache = {}
+    if not engine.fused_transient_enabled():
+        return stats
+    low = (spec if isinstance(spec, _abi.AbiLowered)
+           else _abi.maybe_lower(spec))
+    spec_l = low if low is not None else spec
+    conds_l = low.pad_conditions(conds) if low is not None else conds
+    if cache is None:
+        cache = compile_pool.AOTCache(
+            fingerprint=compile_pool.spec_fingerprint(
+                _prog_spec(spec_l)))
+    elif cache is False:
+        cache = compile_pool.AOTCache(root="off")
+    backend = _resolve_backend()
+    ts = jnp.asarray(save_ts, dtype=jnp.float64)
+    n_lanes = jax.tree_util.tree_leaves(conds_l)[0].shape[0]
+
+    jobs = [(spec_l, _prog_spec(spec_l), _ftrans_kind(opts, backend),
+             _fused_transient_program(_prog_spec(spec_l), opts),
+             (conds_l, ts), f"fused transient @{n_lanes}")]
+    if low is not None:
+        for kraw in sorted({int(x) for x in k_buckets if int(x) > 1}):
+            pk = _abi.pack_lowered([low] * kraw)
+            jobs.append(
+                (pk, pk.program_spec,
+                 _packed_ftrans_kind(opts, backend, pk.k_bucket),
+                 _packed_transient_program(pk.program_spec, opts),
+                 (pk.stack_tenants([conds_l] * kraw), ts),
+                 f"packed transient @{n_lanes} x{pk.k_bucket}"))
+
+    for holder, pspec, kind, prog, argt, label in jobs:
+        args = _prog_args(holder, argt)
+        key = compile_pool.program_key(kind, args)
+        # Per-program transient row in the cost ledger, stamped at
+        # prewarm like the sweep programs.
+        _costs.record(key, kind=kind, label=label)
+        if compile_pool.lookup(pspec, key) is not None:
+            stats.loaded += 1
+            continue
+        exe = None
+        try:
+            exe = cache.load(key)
+        except compile_pool.CacheMismatch:
+            exe = None
+        if exe is not None:
+            compile_pool.register(pspec, key, exe)
+            stats.loaded += 1
+            continue
+        _san_recompile.note_compile(label)
+        _san_trace_ident.note_jaxpr(kind, key, prog, args, force=True)
+        exe = call_with_backend_retry(
+            lambda prog=prog, args=args: prog.lower(*args).compile(),
+            label=f"compile:{label}")
+        _metrics.counter("pycatkin_compile_total",
+                         "fresh XLA compiles through the compile "
+                         "pool").inc()
+        cache.save(key, exe,
+                   sharding=compile_pool.args_sharding_fingerprint(
+                       args))
+        _costs.record(key, kind=kind, cost=_costs.harvest_cost(exe),
+                      source="compiled")
+        compile_pool.register(pspec, key, exe)
+        stats.compiled += 1
+    out = PrewarmStats(len(jobs))
+    out.compiled, out.loaded = stats.compiled, stats.loaded
+    out.executed = 0
+    out.cache_writes = cache.writes
+    out.cache = cache.stats()
+    return out
 
 
 @lru_cache(maxsize=16)
@@ -2508,7 +2837,8 @@ class PrewarmStats(int):
 def prewarm_program_count(buckets=(64, 128, 256), aot_buckets=(),
                           tier2_buckets=(), tier2_aot_buckets=(),
                           tof: bool = True,
-                          check_stability: bool = True) -> int:
+                          check_stability: bool = True,
+                          transient_k_buckets=None) -> int:
     """Programs a :func:`prewarm_sweep_programs` call with this layout
     ensures, WITHOUT compiling anything: ONE fused full-shape sweep
     program (solve + quarantine + tier-0 screen + TOF + diagnostics --
@@ -2524,6 +2854,12 @@ def prewarm_program_count(buckets=(64, 128, 256), aot_buckets=(),
     n += len(set(buckets) | set(aot_buckets))          # rescue
     if check_stability:
         n += len(set(tier2_buckets) | set(tier2_aot_buckets))  # tier-2 jac
+    if transient_k_buckets is not None:
+        # prewarm_transient_programs: one solo fused transient program
+        # plus one packed program per pow2 tenant sub-bucket (None
+        # means no transient prewarm at all; () warms solo only).
+        n += 1 + len({1 << (int(x) - 1).bit_length()
+                      for x in transient_k_buckets if int(x) > 1})
     return n
 
 

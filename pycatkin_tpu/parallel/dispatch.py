@@ -69,19 +69,26 @@ class PackedRequest:
     ``submitted_at`` / ``wait_budget_s`` record when the request
     arrived and how long it agreed to wait for co-tenants (None =
     the coalescer's ``max_wait_s``); the serving layer's SLA-aware
-    flushing derives group deadlines from them."""
+    flushing derives group deadlines from them.
 
-    __slots__ = ("sim", "spec", "conds", "tof_mask", "x0", "group_key",
-                 "submitted_at", "wait_budget_s",
+    A non-None ``save_ts`` marks a TRANSIENT request (docs/
+    perf_transient.md): the group key then carries the save grid, so
+    only same-grid transients co-pack, and the group executes through
+    the coalescer's ``transient_runner`` instead of ``runner``."""
+
+    __slots__ = ("sim", "spec", "conds", "tof_mask", "x0", "save_ts",
+                 "group_key", "submitted_at", "wait_budget_s",
                  "_coalescer", "_result", "done")
 
     def __init__(self, coalescer, sim, spec, conds, tof_mask, x0,
-                 group_key, submitted_at=None, wait_budget_s=None):
+                 group_key, submitted_at=None, wait_budget_s=None,
+                 save_ts=None):
         self.sim = sim
         self.spec = spec
         self.conds = conds
         self.tof_mask = tof_mask
         self.x0 = x0
+        self.save_ts = save_ts
         self.group_key = group_key
         self.submitted_at = submitted_at
         self.wait_budget_s = wait_budget_s
@@ -110,6 +117,24 @@ def _default_packed_runner(sims, conds_list, masks, x0s, *,
         tof_mask=masks, x0=x0s,
         opts=SolverOptions() if opts is None else opts,
         check_stability=check_stability, pos_jac_tol=pos_jac_tol)
+
+
+def _default_transient_runner(sims, conds_list, save_ts, *, opts=None):
+    """Transient-group runner seam default: the in-process packed
+    transient (:func:`parallel.batch.packed_batch_transient`). Returns
+    per-tenant dicts ``{ys, ok, quarantined}`` -- ``quarantined`` marks
+    lanes with a non-finite endpoint, the transient analogue of the
+    sweep quarantine the flush event reports."""
+    from ..solvers.ode import ODEOptions
+    from .batch import packed_batch_transient
+    outs = []
+    for ys, ok in packed_batch_transient(
+            [getattr(s, "spec", s) for s in sims], conds_list, save_ts,
+            opts=ODEOptions() if opts is None else opts):
+        ys, ok = np.asarray(ys), np.asarray(ok)
+        finite = np.isfinite(ys[:, -1, :]).all(axis=-1)
+        outs.append({"ys": ys, "ok": ok, "quarantined": ~finite})
+    return outs
 
 
 class SweepCoalescer:
@@ -154,7 +179,8 @@ class SweepCoalescer:
                  max_wait_s: Optional[float] = None,
                  work_dir: Optional[str] = None,
                  check_stability: bool = False, opts=None,
-                 pos_jac_tol: float = 1e-2, autoflush: bool = True):
+                 pos_jac_tol: float = 1e-2, autoflush: bool = True,
+                 transient_runner=None, ode_opts=None):
         if max_occupancy is None:
             max_occupancy = int(os.environ.get(
                 PACKED_MAX_OCCUPANCY_ENV, _PACKED_MAX_OCCUPANCY_DEFAULT))
@@ -165,6 +191,10 @@ class SweepCoalescer:
             raise ValueError(f"max_occupancy must be >= 1, "
                              f"got {max_occupancy}")
         self.runner = _default_packed_runner if runner is None else runner
+        self.transient_runner = (_default_transient_runner
+                                 if transient_runner is None
+                                 else transient_runner)
+        self.ode_opts = ode_opts
         self.max_occupancy = int(max_occupancy)
         self.max_wait_s = float(max_wait_s)
         self.work_dir = work_dir
@@ -187,7 +217,7 @@ class SweepCoalescer:
         self._solo_seq = itertools.count()
         self.flushes = 0
 
-    def _group_key(self, sim, spec, conds, tof_mask, x0):
+    def _group_key(self, sim, spec, conds, tof_mask, x0, save_ts=None):
         n = len(np.asarray(conds.T))
         fp = None
         try:
@@ -201,6 +231,13 @@ class SweepCoalescer:
         if fp is None:
             # Unpackable mechanism: unique key -> always a solo group.
             return ("solo", next(self._solo_seq), n)
+        if save_ts is not None:
+            # Transient groups carry the exact save grid: the packed
+            # transient program scans ONE shared grid, so only
+            # same-grid requests may co-pack (and they never mix with
+            # steady sweeps).
+            return (fp, n, "transient",
+                    tuple(float(t) for t in save_ts))
         return (fp, n, tof_mask is not None, x0 is not None)
 
     def _deadline_for(self, reqs) -> float:
@@ -213,19 +250,24 @@ class SweepCoalescer:
                    for r in reqs)
 
     def submit(self, sim, conds, tof_mask=None, x0=None,
-               wait_budget_s: Optional[float] = None) -> PackedRequest:
+               wait_budget_s: Optional[float] = None,
+               save_ts=None) -> PackedRequest:
         """Queue one sweep; returns its :class:`PackedRequest` handle.
         With ``autoflush`` (the default) the group flushes inline when
         it reaches ``max_occupancy``. ``wait_budget_s`` caps how long
         THIS request may sit waiting for co-tenants (tightening the
         group deadline below ``max_wait_s``) -- the serving layer
-        derives it from the request's deadline class."""
+        derives it from the request's deadline class. A non-None
+        ``save_ts`` queues a TRANSIENT request instead: grouped by
+        (fingerprint, lanes, grid), executed through
+        ``transient_runner``."""
         import time as _time
         spec = getattr(sim, "spec", sim)
-        key = self._group_key(sim, spec, conds, tof_mask, x0)
+        key = self._group_key(sim, spec, conds, tof_mask, x0, save_ts)
         req = PackedRequest(self, sim, spec, conds, tof_mask, x0, key,
                             submitted_at=_time.monotonic(),
-                            wait_budget_s=wait_budget_s)
+                            wait_budget_s=wait_budget_s,
+                            save_ts=save_ts)
         with self._lock:
             group = self._groups.setdefault(key, [])
             group.append(req)
@@ -313,12 +355,19 @@ class SweepCoalescer:
         """Execute one taken group through ``runner`` NOW, resolve its
         requests and emit the pack-flush event; returns the per-tenant
         result dicts in request order."""
-        masks = [r.tof_mask for r in reqs]
-        x0s = [r.x0 for r in reqs]
-        outs = self.runner(
-            [r.sim for r in reqs], [r.conds for r in reqs], masks, x0s,
-            check_stability=self.check_stability, opts=self.opts,
-            pos_jac_tol=self.pos_jac_tol)
+        if reqs and reqs[0].save_ts is not None:
+            # Transient group (all members share the grid: it is part
+            # of the group key).
+            outs = self.transient_runner(
+                [r.sim for r in reqs], [r.conds for r in reqs],
+                reqs[0].save_ts, opts=self.ode_opts)
+        else:
+            masks = [r.tof_mask for r in reqs]
+            x0s = [r.x0 for r in reqs]
+            outs = self.runner(
+                [r.sim for r in reqs], [r.conds for r in reqs], masks,
+                x0s, check_stability=self.check_stability,
+                opts=self.opts, pos_jac_tol=self.pos_jac_tol)
         if len(outs) != len(reqs):
             raise RuntimeError(
                 f"coalescer runner returned {len(outs)} results for "

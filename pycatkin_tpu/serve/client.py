@@ -64,6 +64,31 @@ def sweep_payload(mechanism, T, p=1.0e5, tof_terms=None,
     return payload
 
 
+def transient_payload(mechanism, T, save_ts, p=1.0e5,
+                      deadline_class: str = "standard",
+                      wait_budget_s: Optional[float] = None,
+                      want=(), req_id=None,
+                      idempotency_key: Optional[str] = None) -> dict:
+    """Assemble one transient request object (docs/serving.md
+    schema): a sweep-shaped conditions grid plus the dense-output
+    save-time grid (``save_ts[0]`` must be 0, strictly increasing)."""
+    payload = {
+        "op": "transient", "id": req_id, "mechanism": mechanism,
+        "conditions": {
+            "T": list(T) if isinstance(T, (list, tuple)) else [T],
+            "p": list(p) if isinstance(p, (list, tuple)) else p},
+        "save_ts": [float(t) for t in save_ts],
+        "deadline_class": deadline_class,
+    }
+    if wait_budget_s is not None:
+        payload["wait_budget_s"] = float(wait_budget_s)
+    if want:
+        payload["return"] = list(want)
+    if idempotency_key is not None:
+        payload["idempotency_key"] = str(idempotency_key)
+    return payload
+
+
 class SweepClient:
     """In-process client: calls the server's request handler directly.
     The ``mechanism`` may be a built ``System`` (skipping the JSON
@@ -77,6 +102,13 @@ class SweepClient:
         req_id = kwargs.pop("req_id", None) or f"c{next(self._seq)}"
         return await self._server.handle(
             sweep_payload(mechanism, T, p=p, req_id=req_id, **kwargs))
+
+    async def transient(self, mechanism, T, save_ts, p=1.0e5,
+                        **kwargs) -> dict:
+        req_id = kwargs.pop("req_id", None) or f"c{next(self._seq)}"
+        return await self._server.handle(
+            transient_payload(mechanism, T, save_ts, p=p,
+                              req_id=req_id, **kwargs))
 
     async def ping(self) -> dict:
         return await self._server.handle({"op": "ping"})
@@ -357,6 +389,11 @@ class TcpSweepClient:
     async def sweep(self, mechanism, T, p=1.0e5, **kwargs) -> dict:
         return await self.request(
             sweep_payload(mechanism, T, p=p, **kwargs))
+
+    async def transient(self, mechanism, T, save_ts, p=1.0e5,
+                        **kwargs) -> dict:
+        return await self.request(
+            transient_payload(mechanism, T, save_ts, p=p, **kwargs))
 
     async def ping(self) -> dict:
         return await self.request({"op": "ping"})

@@ -274,3 +274,35 @@ def parse_sweep_request(payload: dict) -> dict:
             "tof_terms": list(tof_terms) if tof_terms else None,
             "deadline_class": str(cls), "wait_budget_s": wait,
             "want": [str(k) for k in want], "idempotency_key": key}
+
+
+def parse_transient_request(payload: dict) -> dict:
+    """Validate the transient-specific fields of a request payload
+    (docs/serving.md, op ``transient``): the sweep fields minus
+    ``tof_terms``, plus the required dense-output ``save_ts`` grid --
+    at least two non-negative, strictly increasing save times starting
+    at 0. Returns ``{mechanism, T(list), p(list), save_ts(list),
+    deadline_class, wait_budget_s, want, idempotency_key}``; raises
+    :class:`ServeError` (bad_request) with the offending field
+    named."""
+    parsed = parse_sweep_request({**payload, "tof_terms": None})
+    ts = payload.get("save_ts")
+    if not isinstance(ts, (list, tuple)) or len(ts) < 2:
+        raise ServeError(E_BAD_REQUEST, "/save_ts: expected a list of "
+                         "at least 2 save times")
+    try:
+        ts = [float(t) for t in ts]
+    except (TypeError, ValueError):
+        raise ServeError(E_BAD_REQUEST,
+                         "/save_ts: non-numeric entry") from None
+    if ts[0] != 0.0:
+        raise ServeError(E_BAD_REQUEST, "/save_ts: must start at 0 "
+                         "(the reported trajectory includes y0)")
+    if any(b <= a for a, b in zip(ts, ts[1:])):
+        raise ServeError(E_BAD_REQUEST,
+                         "/save_ts: must be strictly increasing")
+    if not all(np.isfinite(ts)):
+        raise ServeError(E_BAD_REQUEST, "/save_ts: non-finite entry")
+    parsed.pop("tof_terms", None)
+    parsed["save_ts"] = ts
+    return parsed

@@ -35,7 +35,8 @@ from ..obs import metrics as _metrics
 from ..utils.profiling import record_event
 from .protocol import (E_BAD_REQUEST, E_DRAINING, E_INTERNAL,
                        E_OVERLOADED, PROTOCOL, ServeConfig, ServeError,
-                       error_response, jsonable, parse_sweep_request)
+                       error_response, jsonable, parse_sweep_request,
+                       parse_transient_request)
 
 # Lane-shaped result keys returned by default; the full solution
 # vector ``y`` rides only on request (``"return": ["y"]``) -- at
@@ -148,16 +149,21 @@ class SweepServer:
                          host=self.config.host, port=self.port)
         return self
 
-    def warm(self, sims, lanes: int, k_buckets=(2, 4, 8)) -> dict:
+    def warm(self, sims, lanes: int, k_buckets=(2, 4, 8),
+             transient_save_ts=None) -> dict:
         """Load-or-compile every program the serve path can dispatch
         for these representative mechanisms at this lane count: the
         solo zoo (K=1 flushes) plus the packed executables for each
-        ``k_bucket``. Blocking -- call before serving traffic (or via
-        ``asyncio.to_thread``). Booted from a warm AOT pack this is
-        deserialization only and the returned ``compiled`` is 0."""
+        ``k_bucket``. Pass ``transient_save_ts`` (a save-time grid) to
+        also warm the fused + packed transient programs the
+        ``transient`` op dispatches. Blocking -- call before serving
+        traffic (or via ``asyncio.to_thread``). Booted from a warm AOT
+        pack this is deserialization only and the returned
+        ``compiled`` is 0."""
         from ..parallel.batch import (broadcast_conditions,
                                       prewarm_packed_sweep_programs,
-                                      prewarm_sweep_programs)
+                                      prewarm_sweep_programs,
+                                      prewarm_transient_programs)
         compiled = loaded = 0
         for sim in sims:
             spec = getattr(sim, "spec", sim)
@@ -166,6 +172,12 @@ class SweepServer:
                                         check_stability=False)
             compiled += st.compiled
             loaded += st.loaded
+            if transient_save_ts is not None:
+                st = prewarm_transient_programs(
+                    spec, conds, transient_save_ts,
+                    k_buckets=k_buckets)
+                compiled += st.compiled
+                loaded += st.loaded
             for k in k_buckets:
                 if k < 2:
                     continue
@@ -274,6 +286,8 @@ class SweepServer:
                         "draining": True}
             if op == "sweep":
                 return await self._handle_sweep(payload, req_id)
+            if op == "transient":
+                return await self._handle_transient(payload, req_id)
             raise ServeError(E_BAD_REQUEST, f"unknown op {op!r}")
         except ServeError as exc:
             self._rejected_total += 1
@@ -363,6 +377,89 @@ class SweepServer:
         return {
             "protocol": PROTOCOL, "id": req_id, "ok": True,
             "lanes": len(parsed["T"]),
+            "result": jsonable(result),
+            "quarantine": {"count": int(q.sum()),
+                           "lanes": np.nonzero(q)[0].tolist()},
+            "lane_telemetry": jsonable(out.get("lane_telemetry")),
+            "manifest": jsonable(manifest),
+            "pack": jsonable({k: v for k, v in pack.items()
+                              if k != "solve_s"}),
+            "timing": {"total_s": total_s, "solve_s": solve_s,
+                       "queue_s": max(0.0, total_s - solve_s)},
+        }
+
+    async def _handle_transient(self, payload: dict, req_id) -> dict:
+        from ..robustness import faults
+        t0 = time.monotonic()
+        self._requests_total += 1
+        _metrics.counter("pycatkin_serve_requests_total",
+                         "sweep requests admitted or rejected").inc()
+        parsed = parse_transient_request(payload)
+        if self._draining:
+            raise ServeError(E_DRAINING,
+                             "server is draining; no new sweeps")
+        if self.pending >= self.config.max_pending:
+            raise ServeError(
+                E_OVERLOADED,
+                f"pending queue is full ({self.pending} >= "
+                f"{self.config.max_pending}); retry with backoff")
+        faults.inject("serve:accept")
+        self._admitted += 1
+        try:
+            sim = await asyncio.to_thread(self._build_system,
+                                          parsed["mechanism"])
+            conds = await asyncio.to_thread(self._build_conds, sim,
+                                            parsed["T"], parsed["p"])
+            wait = parsed["wait_budget_s"]
+            if wait is None:
+                wait = self.config.wait_budget_for(
+                    parsed["deadline_class"])
+            fut = asyncio.get_running_loop().create_future()
+            req = self._coalescer.submit(sim, conds,
+                                         wait_budget_s=wait,
+                                         save_ts=parsed["save_ts"])
+            self._futures[req] = fut
+            if self._stopping:
+                # The scheduler is gone; nothing will ever flush this.
+                self._futures.pop(req, None)
+                raise ServeError(E_DRAINING,
+                                 "server stopped during admission")
+            _metrics.gauge("pycatkin_serve_queue_depth",
+                           "sweep requests queued, unflushed").set(
+                               float(self._coalescer.pending))
+            self._wake.set()
+            out, pack = await fut
+        finally:
+            self._admitted -= 1
+        total_s = time.monotonic() - t0
+        _metrics.histogram("pycatkin_serve_request_seconds",
+                           "accepted sweep request wall time").observe(
+                               total_s,
+                               deadline_class=parsed["deadline_class"])
+        self._completed_total += 1
+        return self._transient_response(req_id, out, pack, parsed,
+                                        total_s)
+
+    def _transient_response(self, req_id, out: dict, pack: dict,
+                            parsed: dict, total_s: float) -> dict:
+        ys = np.asarray(out["ys"])
+        result = {"ok": np.asarray(out["ok"]),
+                  "endpoint": ys[:, -1, :]}
+        if "ys" in parsed["want"]:
+            result["ys"] = ys
+        for key in parsed["want"]:
+            if key != "ys" and key in out:
+                result[key] = out[key]
+        q = np.asarray(out.get("quarantined", ()), dtype=bool)
+        manifest = dict(self.boot_manifest)
+        manifest["abi"] = {
+            "fingerprint": (pack.get("abi_fingerprint")),
+            "packed": pack.get("tenants", 1) > 1}
+        solve_s = pack.get("solve_s", 0.0)
+        return {
+            "protocol": PROTOCOL, "id": req_id, "ok": True,
+            "lanes": len(parsed["T"]),
+            "save_points": len(parsed["save_ts"]),
             "result": jsonable(result),
             "quarantine": {"count": int(q.sum()),
                            "lanes": np.nonzero(q)[0].tolist()},

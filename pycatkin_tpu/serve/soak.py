@@ -42,6 +42,13 @@ SCHEMA = "pycatkin-serve-soak/v1"
 REQUIRED_RESPONSE_FIELDS = ("result", "manifest", "lane_telemetry",
                             "quarantine", "pack", "timing")
 
+# Largest ABI bucket the soak mixes `transient` requests into. Dense
+# transient device time is step-count-bound per save interval, so a
+# warm bucket-128 flush runs ~30 s on CPU -- fine for bench.py
+# --transient's throughput lane, ruinous for a latency-gated mix where
+# it serializes every co-resident sweep flush behind it.
+TRANSIENT_MIX_MAX_BUCKET = 32
+
 
 def _audit_response(resp: dict) -> list:
     """Names of required fields missing from an ok response.
@@ -56,10 +63,13 @@ def _audit_response(resp: dict) -> list:
     # not a cosmetic one.
     result = resp.get("result")
     if isinstance(result, dict):
-        succ = result.get("success")
+        # Transient responses carry ``save_points`` and a per-lane
+        # ``ok`` verdict; sweeps carry per-lane ``success``.
+        key = "ok" if "save_points" in resp else "success"
+        succ = result.get(key)
         if not (isinstance(succ, list)
                 and len(succ) == resp.get("lanes")):
-            bad.append("result.success")
+            bad.append(f"result.{key}")
     return bad
 
 
@@ -80,11 +90,20 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
                      deadline_class: str = "standard",
                      t_range=(480.0, 520.0),
                      drain_burst: Optional[int] = None,
+                     transient_frac: float = 0.0,
                      verbose: bool = False) -> dict:
     """Run the full soak against a fresh server; returns the BENCH
     record. ``transport`` is ``"inproc"`` (direct handler calls,
     mechanisms passed as built Systems) or ``"tcp"`` (full JSON wire
-    round-trip on localhost)."""
+    round-trip on localhost). ``transient_frac`` > 0 mixes that
+    fraction of ``transient`` (dense-output) requests into the
+    measured stream on a fixed log-spaced save grid -- warmed,
+    coalesced and audited exactly like sweeps. Transients mix only on
+    buckets <= TRANSIENT_MIX_MAX_BUCKET: a dense sweep's device time
+    is step-count-bound per save interval, so at the big buckets one
+    warm flush runs ~30 s on CPU -- a throughput job that belongs in
+    ``bench.py --transient``, not in a latency-gated request mix it
+    would serialize every co-resident sweep behind."""
     from ..models.synthetic import synthetic_system_for_bucket
     from .client import SweepClient, TcpSweepClient
     from .protocol import ServeConfig
@@ -129,11 +148,29 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
     def random_T():
         return [float(t) for t in rng.uniform(*t_range, size=lanes)]
 
-    async def one_request(sim, sem, latencies, failures, violations):
+    # One fixed save grid for the whole soak: every transient request
+    # shares it, so same-bucket transients coalesce into packed
+    # flushes just like sweeps. Only the small buckets mix transients
+    # (see the docstring); with no eligible bucket the mix degrades to
+    # a pure sweep soak.
+    save_ts = [0.0] + [float(t) for t in np.logspace(-9, 0, 13)]
+    transient_buckets = [b for b in buckets
+                         if b <= TRANSIENT_MIX_MAX_BUCKET]
+    if transient_frac > 0 and not transient_buckets:
+        transient_frac = 0.0
+
+    async def one_request(sim, sem, latencies, failures, violations,
+                          transient=False):
         async with sem:
             t0 = time.monotonic()
-            resp = await client.sweep(payload_mech(sim), random_T(),
-                                      deadline_class=deadline_class)
+            if transient:
+                resp = await client.transient(
+                    payload_mech(sim), random_T(), save_ts,
+                    deadline_class=deadline_class)
+            else:
+                resp = await client.sweep(
+                    payload_mech(sim), random_T(),
+                    deadline_class=deadline_class)
             dt = time.monotonic() - t0
             if resp.get("ok"):
                 latencies.append(dt)
@@ -152,6 +189,12 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
         prewarm = await asyncio.to_thread(
             server.warm, [pool[b][0] for b in buckets], lanes,
             tuple(k for k in k_buckets if k > 1))
+        if transient_frac > 0:
+            # Transient programs only for the buckets that mix them.
+            tw = await asyncio.to_thread(
+                server.warm, [pool[b][0] for b in transient_buckets],
+                lanes, tuple(k for k in k_buckets if k > 1), save_ts)
+            prewarm = {k: prewarm[k] + tw[k] for k in prewarm}
         say(f"prewarm: {prewarm}")
         warm_lat, warm_fail, warm_viol = [], [], []
         sem = asyncio.Semaphore(concurrency)
@@ -163,10 +206,19 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
                 warm_jobs.append(one_request(
                     pool[b][i % len(pool[b])], sem, warm_lat,
                     warm_fail, warm_viol))
+            if transient_frac > 0 and b in transient_buckets:
+                for i in range(max_occupancy):
+                    warm_jobs.append(one_request(
+                        pool[b][i % len(pool[b])], sem, warm_lat,
+                        warm_fail, warm_viol, transient=True))
         await asyncio.gather(*warm_jobs)
         for b in buckets:
             await one_request(pool[b][0], sem, warm_lat, warm_fail,
                               warm_viol)
+            if transient_frac > 0 and b in transient_buckets:
+                await one_request(pool[b][0], sem, warm_lat,
+                                  warm_fail, warm_viol,
+                                  transient=True)
         server.mark_warm()
         n_warmup = len(warm_lat) + len(warm_fail)
         say(f"warmup done: {n_warmup} requests "
@@ -175,13 +227,18 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
         # -- phase 3: measured stream ---------------------------------
         latencies, failures, violations = [], [], []
         jobs = []
+        n_transient = 0
         for i in range(n_requests):
             b = buckets[i % len(buckets)]
             sim = pool[b][int(rng.integers(0, len(pool[b])))]
+            transient = (transient_frac > 0
+                         and b in transient_buckets
+                         and rng.random() < transient_frac)
+            n_transient += int(transient)
             jobs.append(one_request(sim, sem, latencies, failures,
-                                    violations))
+                                    violations, transient=transient))
         say(f"streaming {n_requests} measured requests "
-            f"(concurrency {concurrency})")
+            f"({n_transient} transient, concurrency {concurrency})")
         t_meas0 = time.monotonic()
         await asyncio.gather(*jobs)
         measure_s = time.monotonic() - t_meas0
@@ -228,6 +285,8 @@ async def soak_async(n_requests: int = 1000, buckets=(16, 32, 128),
         "aot_pack": bool(aot_pack),
         "n_requests": n_requests, "n_ok": len(latencies),
         "n_failed": len(failures),
+        "n_transient": n_transient,
+        "transient_frac": transient_frac,
         "n_warmup": n_warmup, "n_drain_burst": nb,
         "buckets": list(buckets), "lanes": lanes,
         "mechs_per_bucket": mechs_per_bucket,

@@ -222,6 +222,99 @@ def last_rname(sim):
     return sim.spec.rnames[-1]
 
 
+SAVE_TS = [0.0, 1e-7, 1e-5, 1e-3]
+
+
+def test_parse_transient_request_names_the_offending_field():
+    from pycatkin_tpu.serve.protocol import parse_transient_request
+    base = {"mechanism": {}, "conditions": {"T": 500}}
+    cases = [
+        (dict(base), "/save_ts"),
+        (dict(base, save_ts=[0.0]), "/save_ts"),
+        (dict(base, save_ts=[1e-6, 1e-3]), "/save_ts"),
+        (dict(base, save_ts=[0.0, 1e-3, 1e-6]), "/save_ts"),
+        (dict(base, save_ts=[0.0, float("nan")]), "/save_ts"),
+        (dict(base, save_ts="soon"), "/save_ts"),
+    ]
+    for payload, field in cases:
+        with pytest.raises(ServeError) as exc:
+            parse_transient_request(payload)
+        assert exc.value.code == E_BAD_REQUEST
+        assert field in str(exc.value), payload
+    parsed = parse_transient_request(dict(base, save_ts=SAVE_TS))
+    assert parsed["save_ts"] == SAVE_TS
+    assert parsed["T"] == [500.0]
+    assert "tof_terms" not in parsed
+
+
+def test_transient_round_trip_coalesces_by_grid(sims):
+    """Two same-bucket same-grid ``transient`` requests ride ONE
+    packed flush; a different save grid starts its own group (grids
+    are traced shapes/values of the packed program, so co-flushing
+    them would be wrong). Response schema: dense-output metadata,
+    per-lane ok verdicts, endpoint coverages, quarantine, pack."""
+    async def scenario():
+        server = await SweepServer(ServeConfig()).start(listen=False)
+        try:
+            client = SweepClient(server)
+            resps = await asyncio.gather(*(
+                client.transient(sim, T_GRID, SAVE_TS,
+                                 wait_budget_s=0.5, want=["ys"])
+                for sim in sims))
+            n_s = np.asarray(resps[0]["result"]["endpoint"]).shape[-1]
+            for resp in resps:
+                assert resp["ok"], resp.get("error")
+                assert resp["lanes"] == N_LANES
+                assert resp["save_points"] == len(SAVE_TS)
+                assert resp["manifest"]["abi"]["packed"]
+                assert resp["pack"]["tenants"] == 2
+                assert len(resp["result"]["ok"]) == N_LANES
+                assert all(resp["result"]["ok"])
+                ys = np.asarray(resp["result"]["ys"])
+                assert ys.shape == (N_LANES, len(SAVE_TS), n_s)
+                ep = np.asarray(resp["result"]["endpoint"])
+                assert ep.shape == (N_LANES, n_s)
+                assert np.array_equal(ep, ys[:, -1, :])
+                assert resp["quarantine"]["count"] == 0
+                assert {"total_s", "solve_s",
+                        "queue_s"} <= set(resp["timing"])
+            assert (resps[0]["pack"]["flush_seq"]
+                    == resps[1]["pack"]["flush_seq"])
+            assert server.stats()["flushes"] == 1
+            # A different grid may not share the flush.
+            other = await client.transient(
+                sims[0], T_GRID, [0.0, 1e-6], wait_budget_s=0.05)
+            assert other["ok"] and other["save_points"] == 2
+            assert (other["pack"]["flush_seq"]
+                    != resps[0]["pack"]["flush_seq"])
+        finally:
+            await server.drain()
+
+    asyncio.run(scenario())
+
+
+def test_transient_and_sweep_requests_never_co_flush(sims):
+    """The coalescer keys transients apart from steady sweeps even at
+    the same fingerprint and lane count -- their runners and traced
+    programs differ."""
+    async def scenario():
+        server = await SweepServer(ServeConfig()).start(listen=False)
+        try:
+            client = SweepClient(server)
+            rt, rs = await asyncio.gather(
+                client.transient(sims[0], T_GRID, SAVE_TS,
+                                 wait_budget_s=0.5),
+                client.sweep(sims[1], T_GRID, wait_budget_s=0.5))
+            assert rt["ok"] and rs["ok"]
+            assert rt["pack"]["flush_seq"] != rs["pack"]["flush_seq"]
+            assert "save_points" in rt and "save_points" not in rs
+            assert server.stats()["flushes"] == 2
+        finally:
+            await server.drain()
+
+    asyncio.run(scenario())
+
+
 def test_tcp_round_trip_and_drain_loses_nothing(sims):
     async def scenario():
         server = await SweepServer(ServeConfig(port=0)).start()

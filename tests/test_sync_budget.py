@@ -113,6 +113,59 @@ def test_packed_clean_sweep_spends_one_sync_regardless_of_k(
     clear_program_caches()
 
 
+def test_fused_clean_transient_spends_one_sync():
+    """The fused transient sweep's whole clean path is ONE counted
+    sync: the batched (ys, ok, bundle) pull (docs/perf_transient.md).
+    The host chunk loop it replaces spent one per chunk plus the
+    finish."""
+    from pycatkin_tpu.parallel.batch import batch_transient
+    sim = synthetic_system(n_species=12, n_reactions=14, seed=5)
+    conds = broadcast_conditions(sim.conditions(), 4)
+    conds = conds._replace(T=np.linspace(480.0, 540.0, 4))
+    save_ts = np.concatenate([[0.0], np.logspace(-9, -2, 9)])
+    batch_transient(sim.spec, conds, save_ts)   # warm, uncounted
+    with profiling.sync_budget() as budget:
+        _, ok = batch_transient(sim.spec, conds, save_ts)
+    assert bool(np.all(np.asarray(ok))), \
+        "budget only applies to a clean transient; this one failed"
+    assert budget.count == 1, (
+        f"fused clean transient spent {budget.count} counted syncs "
+        f"(expected exactly 1): {budget.labels}")
+    assert budget.labels == ["fused transient bundle"]
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_packed_clean_transient_spends_one_sync_regardless_of_k(
+        k, monkeypatch):
+    """K same-bucket transient sweeps ride ONE counted sync total --
+    the stacked (ys, ok, bundle) pull -- exactly like the packed
+    steady-state path."""
+    from pycatkin_tpu.frontend import abi
+    from pycatkin_tpu.parallel.batch import (clear_program_caches,
+                                             packed_batch_transient)
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    clear_program_caches()
+    specs, conds_l = [], []
+    for seed in range(k):
+        sim = synthetic_system(n_species=12, n_reactions=14, seed=seed)
+        conds = broadcast_conditions(sim.conditions(), 4)
+        conds_l.append(conds._replace(
+            T=np.linspace(470.0, 540.0, 4) + 2.0 * seed))
+        specs.append(sim.spec)
+    save_ts = np.concatenate([[0.0], np.logspace(-9, -2, 9)])
+    packed_batch_transient(specs, conds_l, save_ts)   # warm
+    with profiling.sync_budget() as budget:
+        outs = packed_batch_transient(specs, conds_l, save_ts)
+    assert all(bool(np.all(np.asarray(ok))) for _, ok in outs), \
+        "budget only applies to a clean pack; this one had failures"
+    assert budget.count == 1, (
+        f"packed clean transient (K={k}) spent {budget.count} counted "
+        f"syncs (expected exactly 1): {budget.labels}")
+    assert budget.labels == ["packed transient bundle"]
+    clear_program_caches()
+
+
 def test_legacy_clean_sweep_within_sync_budget(problem, monkeypatch):
     """The split tail (fused path disabled) must stay at 2 counted
     syncs: solve fence + packed tail bundle."""

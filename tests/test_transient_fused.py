@@ -1,0 +1,197 @@
+"""Fused dense-output transient sweeps (docs/perf_transient.md).
+
+The fused path collapses the host chunk loop into ONE traced program:
+same math, same grid, ONE dispatch and ONE counted sync. Every
+contract here is a bitwise one -- "close enough" would let the fused
+and chunked worlds drift apart, and the serving layer advertises
+fused/chunked (and packed/solo) interchangeability as an exact
+equivalence, not a tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pycatkin_tpu import engine
+from pycatkin_tpu.frontend import abi
+from pycatkin_tpu.models.synthetic import synthetic_system
+from pycatkin_tpu.parallel import batch as _batch
+from pycatkin_tpu.parallel.batch import (batch_transient,
+                                         broadcast_conditions,
+                                         clear_program_caches,
+                                         packed_batch_transient,
+                                         prewarm_transient_programs)
+from pycatkin_tpu.robustness import FaultPlan, FaultSpec, fault_scope
+from pycatkin_tpu.utils import profiling
+
+LANES = 4
+SAVE_TS = np.concatenate([[0.0], np.logspace(-9, -2, 9)])
+
+
+def _problem(seed=7, lanes=LANES, dT=0.0):
+    sim = synthetic_system(n_species=12, n_reactions=14, seed=seed)
+    conds = broadcast_conditions(sim.conditions(), lanes)
+    conds = conds._replace(T=np.linspace(480.0, 545.0, lanes) + dT)
+    return sim.spec, conds
+
+
+def _bits(ys, ok):
+    ys, ok = np.asarray(ys), np.asarray(ok)
+    return (ys.dtype, ys.shape, ys.tobytes(),
+            ok.dtype, ok.shape, ok.tobytes())
+
+
+def test_fused_matches_chunked_fallback_bitwise(monkeypatch):
+    """PYCATKIN_FUSED_TRANSIENT=0 reroutes batch_transient through the
+    host chunk loop; the output must be bit-identical -- the env knob
+    is an escape hatch, never a different answer."""
+    spec, conds = _problem()
+    ys_f, ok_f = batch_transient(spec, conds, SAVE_TS)
+    assert bool(np.asarray(ok_f).all())
+    monkeypatch.setenv(engine.FUSED_TRANSIENT_ENV, "0")
+    ys_c, ok_c = batch_transient(spec, conds, SAVE_TS)
+    assert _bits(ys_f, ok_f) == _bits(ys_c, ok_c)
+
+
+def test_chunked_drive_uneven_chunks_bitwise():
+    """force_chunking with a chunk size that does not divide the grid
+    exercises the ragged-tail chunk; still bit-identical to fused."""
+    spec, conds = _problem()
+    opts = engine.ODEOptions()
+    ys_f, ok_f = batch_transient(spec, conds, SAVE_TS, opts=opts)
+    cprog = _batch._transient_chunk_program(_batch._prog_spec(spec),
+                                           opts)
+    fprog = _batch._transient_finish_program(
+        _batch._prog_spec(spec), engine.finish_options(opts))
+    # 10 save points, chunk=4 -> chunks of 4, 4, 1 (plus the finish).
+    ys_c, ok_c = engine.chunked_transient_drive(
+        cprog, fprog, conds, jnp.asarray(conds.y0, dtype=jnp.float64),
+        SAVE_TS, opts, chunk=4, batched=True, force_chunking=True)
+    assert _bits(ys_f, ok_f) == _bits(ys_c, ok_c)
+
+
+@pytest.mark.parametrize("tier", ["", "f32-polish"])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_packed_matches_solo_bitwise(k, tier, monkeypatch):
+    """K same-bucket transient sweeps through one packed dispatch are
+    per-tenant bitwise identical to K solo runs, in both precision
+    tiers (the transient trace is pure f64 -- the tier is a cache key
+    only, so the answers cannot differ either)."""
+    from pycatkin_tpu import precision
+    if tier:
+        monkeypatch.setenv(precision.TIER_ENV, tier)
+    else:
+        monkeypatch.delenv(precision.TIER_ENV, raising=False)
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    clear_program_caches()
+    try:
+        specs, conds_l = [], []
+        for seed in range(k):
+            spec, conds = _problem(seed=seed, dT=2.0 * seed)
+            specs.append(spec)
+            conds_l.append(conds)
+        solo = [batch_transient(s, c, SAVE_TS)
+                for s, c in zip(specs, conds_l)]
+        packed = packed_batch_transient(specs, conds_l, SAVE_TS)
+        assert len(packed) == k
+        for (ys_s, ok_s), (ys_p, ok_p) in zip(solo, packed):
+            assert bool(np.asarray(ok_s).all())
+            assert _bits(ys_s, ok_s) == _bits(ys_p, ok_p)
+    finally:
+        clear_program_caches()
+
+
+def test_poisoned_tenant_is_isolated(monkeypatch):
+    """A NaN-poisoned tenant fails its own lane verdicts without
+    perturbing a single bit of its co-tenant -- the isolation promise
+    that makes multi-tenant packing safe to serve."""
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    clear_program_caches()
+    try:
+        spec0, conds0 = _problem(seed=0)
+        spec1, conds1 = _problem(seed=1, dT=2.0)
+        y0 = np.asarray(conds1.y0, dtype=np.float64).copy()
+        y0[1, :] = np.nan
+        conds1 = conds1._replace(y0=y0)
+        ys_solo, ok_solo = batch_transient(spec0, conds0, SAVE_TS)
+        packed = packed_batch_transient([spec0, spec1],
+                                        [conds0, conds1], SAVE_TS)
+        ys_p0, ok_p0 = packed[0]
+        _, ok_p1 = packed[1]
+        assert _bits(ys_solo, ok_solo) == _bits(ys_p0, ok_p0)
+        assert not bool(np.asarray(ok_p1)[1]), \
+            "the poisoned lane must not report success"
+    finally:
+        clear_program_caches()
+
+
+def test_fault_plan_degrades_to_chunked_path():
+    """Any active fault plan -- even one whose sites never fire --
+    disables the fused route: the injection sites (chunk boundaries,
+    finish) live on the host-driven path, so drills must keep
+    exercising it. The sync labels prove which path ran."""
+    spec, conds = _problem()
+    batch_transient(spec, conds, SAVE_TS)   # warm fused (uncounted)
+    plan = FaultPlan([FaultSpec(site="nosuch:site", kind="transient")])
+    with fault_scope(plan):
+        assert not engine.fused_transient_enabled()
+        with profiling.sync_budget() as budget:
+            ys, ok = batch_transient(spec, conds, SAVE_TS)
+    assert bool(np.asarray(ok).all())
+    assert "fused transient bundle" not in budget.labels
+    assert any(lb.startswith("transient chunk[") for lb in budget.labels)
+    assert "transient finish" in budget.labels
+    # And back out of the scope the fused route returns.
+    assert engine.fused_transient_enabled()
+    with profiling.sync_budget() as budget:
+        ys_f, ok_f = batch_transient(spec, conds, SAVE_TS)
+    assert budget.labels == ["fused transient bundle"]
+    assert _bits(ys, ok) == _bits(ys_f, ok_f)
+
+
+def _compile_total():
+    from pycatkin_tpu.obs import metrics as _metrics
+    return float(sum(
+        _metrics.counter("pycatkin_compile_total").values().values()))
+
+
+def test_prewarm_covers_solo_and_packed(monkeypatch):
+    """prewarm_transient_programs compiles the solo fused program plus
+    one packed program per requested tenant bucket; the subsequent
+    solo AND packed dispatches then compile NOTHING -- the property the
+    serve layer's warm() relies on for its zero-compile SLO. Transient
+    programs key on the save-grid LENGTH, so a different grid of the
+    same length is covered too."""
+    monkeypatch.setenv(abi.ABI_ENV, "1")
+    monkeypatch.setenv("PYCATKIN_AOT_CACHE", "off")
+    clear_program_caches()
+    try:
+        spec, conds = _problem(seed=3)
+        stats = prewarm_transient_programs(spec, conds, SAVE_TS,
+                                           k_buckets=(2,))
+        assert stats.compiled + stats.loaded == 2
+        spec_b, conds_b = _problem(seed=4, dT=3.0)
+        prewarm_transient_programs(spec_b, conds_b, SAVE_TS)
+        before = _compile_total()
+        batch_transient(spec, conds, SAVE_TS)
+        other_grid = np.concatenate([[0.0], np.logspace(-8, -1, 9)])
+        packed_batch_transient([spec, spec_b], [conds, conds_b],
+                               other_grid)
+        assert _compile_total() == before, \
+            "prewarmed transient dispatches must compile nothing"
+    finally:
+        clear_program_caches()
+
+
+def test_fused_transient_enabled_env_parsing(monkeypatch):
+    for off in ("0", "off", "NONE", "Disabled", "false"):
+        monkeypatch.setenv(engine.FUSED_TRANSIENT_ENV, off)
+        assert not engine.fused_transient_enabled(), off
+    for on in ("1", "on", "yes", ""):
+        monkeypatch.setenv(engine.FUSED_TRANSIENT_ENV, on)
+        assert engine.fused_transient_enabled(), repr(on)
+    monkeypatch.delenv(engine.FUSED_TRANSIENT_ENV, raising=False)
+    assert engine.fused_transient_enabled()

@@ -5,7 +5,10 @@ Streams randomized synthetic mechanisms through a live server
 (``serve/soak.py``) and writes a BENCH-style JSON record carrying
 p50/p99 latency, achieved pack occupancy and the post-warmup
 zero-compile rate -- metrics ``tools/perfwatch.py`` baselines with the
-same median±MAD sentinel as sweep throughput.
+same median±MAD sentinel as sweep throughput. The measured stream
+mixes ``--transient-frac`` (default 0.25) dense-output ``transient``
+requests into the bucket mix, warmed and coalesced like sweeps
+(small buckets only -- serve/soak.py TRANSIENT_MIX_MAX_BUCKET).
 
 Usage::
 
@@ -68,7 +71,8 @@ def _run(args) -> int:
         mechs_per_bucket=args.mechs_per_bucket,
         max_occupancy=args.max_occupancy,
         concurrency=args.concurrency, runner=args.runner,
-        aot_pack=args.aot_pack, verbose=args.verbose)
+        aot_pack=args.aot_pack,
+        transient_frac=args.transient_frac, verbose=args.verbose)
     if args.export_pack:
         from pycatkin_tpu.parallel import compile_pool
         stats = compile_pool.export_cache_pack(args.export_pack)
@@ -103,7 +107,8 @@ def _cmd_check(args) -> int:
         env["PYCATKIN_AOT_CACHE"] = cache
         common = ["--buckets", args.buckets, "--lanes",
                   str(args.lanes), "--max-occupancy",
-                  str(args.max_occupancy), "--seed", str(args.seed)]
+                  str(args.max_occupancy), "--seed", str(args.seed),
+                  "--transient-frac", str(args.transient_frac)]
         warm_cmd = [sys.executable, me, "--n", "12",
                     "--mechs-per-bucket", "2",
                     "--export-pack", pack] + common
@@ -231,6 +236,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tcp", action="store_true",
                     help="full wire round-trip (default: in-process)")
     ap.add_argument("--mechs-per-bucket", type=int, default=6)
+    ap.add_argument("--transient-frac", type=float, default=0.25,
+                    help="fraction of transient (dense-output) "
+                         "requests mixed into the measured stream "
+                         "(0 disables)")
     ap.add_argument("--max-occupancy", type=int, default=8)
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--runner", choices=("inproc", "elastic"),
